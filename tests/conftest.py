@@ -1,0 +1,24 @@
+"""Test harness: force a virtual 8-device CPU platform.
+
+This is the JAX-native analogue of a fake multi-GPU backend (SURVEY.md §4):
+distributed tests build a real ``jax.sharding.Mesh`` over 8 host-platform
+devices, so sharding/collective code paths compile and execute without TPU
+hardware.
+
+Note: this environment's sitecustomize registers a TPU PJRT plugin in every
+interpreter before conftest runs, so setting JAX_PLATFORMS in os.environ here
+would be too late — we must flip ``jax.config`` directly (backends initialize
+lazily, so this still wins as long as it happens before first use).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,210 @@
+"""Layer/stack/assembly tests (L2/L3): residual wiring, variants, KV-cache
+decode equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.models import (
+    decoder_apply,
+    decoder_init,
+    encoder_apply,
+    encoder_init,
+    transformer_apply,
+    transformer_init,
+)
+from transformer_tpu.models.decoder import init_decoder_caches
+from transformer_tpu.models.transformer import transformer_decode_step
+from transformer_tpu.ops.masks import make_padding_mask, make_seq2seq_masks
+
+TINY = ModelConfig(
+    num_layers=2,
+    d_model=16,
+    num_heads=2,
+    dff=32,
+    input_vocab_size=40,
+    target_vocab_size=48,
+    max_position=64,
+    dropout_rate=0.1,
+    dtype="float32",
+)
+
+
+def tokens(key, vocab, shape):
+    return jax.random.randint(key, shape, 1, vocab)
+
+
+class TestEncoder:
+    def test_output_shape_and_dtype(self):
+        params = encoder_init(jax.random.PRNGKey(0), TINY)
+        ids = tokens(jax.random.PRNGKey(1), 40, (3, 7))
+        out, attn = encoder_apply(params, ids, make_padding_mask(ids), TINY)
+        assert out.shape == (3, 7, 16)
+        assert attn == {}
+
+    def test_attention_weights_collected(self):
+        params = encoder_init(jax.random.PRNGKey(0), TINY)
+        ids = tokens(jax.random.PRNGKey(1), 40, (2, 5))
+        _, attn = encoder_apply(
+            params, ids, make_padding_mask(ids), TINY, return_weights=True
+        )
+        assert set(attn) == {"encoder_layer1", "encoder_layer2"}
+        assert attn["encoder_layer1"].shape == (2, 2, 5, 5)
+
+    def test_dropout_changes_output_only_in_training(self):
+        params = encoder_init(jax.random.PRNGKey(0), TINY)
+        ids = tokens(jax.random.PRNGKey(1), 40, (2, 5))
+        det, _ = encoder_apply(params, ids, None, TINY, deterministic=True)
+        det2, _ = encoder_apply(params, ids, None, TINY, deterministic=True)
+        np.testing.assert_array_equal(np.asarray(det), np.asarray(det2))
+        tr, _ = encoder_apply(
+            params, ids, None, TINY, rng=jax.random.PRNGKey(2), deterministic=False
+        )
+        assert not np.allclose(np.asarray(det), np.asarray(tr))
+
+    def test_padding_position_does_not_affect_others(self):
+        """Changing a padded token's embedding input must not change non-pad
+        outputs (mask correctness end-to-end)."""
+        params = encoder_init(jax.random.PRNGKey(0), TINY)
+        ids1 = jnp.array([[5, 6, 7, 0, 0]])
+        ids2 = jnp.array([[5, 6, 7, 0, 0]])
+        mask = make_padding_mask(ids1)
+        out1, _ = encoder_apply(params, ids1, mask, TINY)
+        out2, _ = encoder_apply(params, ids2, mask, TINY)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :3]), np.asarray(out2[:, :3]), atol=1e-6
+        )
+
+
+class TestDecoder:
+    def test_attention_dict_keys_parity(self):
+        """Keys follow the reference's decoder_layer{i}_block{1,2} scheme
+        (Decoder.py:75-76)."""
+        dec = decoder_init(jax.random.PRNGKey(0), TINY)
+        enc = encoder_init(jax.random.PRNGKey(1), TINY)
+        inp = tokens(jax.random.PRNGKey(2), 40, (2, 6))
+        tar = tokens(jax.random.PRNGKey(3), 48, (2, 4))
+        enc_mask, combined, cross = make_seq2seq_masks(inp, tar)
+        enc_out, _ = encoder_apply(enc, inp, enc_mask, TINY)
+        out, attn, _ = decoder_apply(
+            dec, tar, enc_out, combined, cross, TINY, return_weights=True
+        )
+        assert out.shape == (2, 4, 16)
+        assert set(attn) == {
+            "decoder_layer1_block1",
+            "decoder_layer1_block2",
+            "decoder_layer2_block1",
+            "decoder_layer2_block2",
+        }
+        assert attn["decoder_layer1_block1"].shape == (2, 2, 4, 4)
+        assert attn["decoder_layer1_block2"].shape == (2, 2, 4, 6)
+
+    def test_causality(self):
+        """Changing target token t must not change decoder outputs before t."""
+        cfg = TINY
+        dec = decoder_init(jax.random.PRNGKey(0), cfg)
+        enc_out = jax.random.normal(jax.random.PRNGKey(1), (1, 6, 16))
+        tar1 = jnp.array([[3, 4, 5, 6]])
+        tar2 = jnp.array([[3, 4, 5, 9]])
+        _, combined1, _ = make_seq2seq_masks(jnp.ones((1, 6), jnp.int32), tar1)
+        _, combined2, _ = make_seq2seq_masks(jnp.ones((1, 6), jnp.int32), tar2)
+        out1, _, _ = decoder_apply(dec, tar1, enc_out, combined1, None, cfg)
+        out2, _, _ = decoder_apply(dec, tar2, enc_out, combined2, None, cfg)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :3]), np.asarray(out2[:, :3]), atol=1e-6
+        )
+
+
+class TestTransformer:
+    def test_logits_shape(self):
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 7))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 5))
+        logits, attn = transformer_apply(params, inp, tar, TINY)
+        assert logits.shape == (2, 5, 48)
+        assert attn == {}
+
+    def test_jit_compiles_once_for_static_shapes(self):
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        fwd = jax.jit(lambda p, i, t: transformer_apply(p, i, t, TINY)[0])
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 7))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 5))
+        l1 = fwd(params, inp, tar)
+        l2 = fwd(params, inp, tar)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    def test_tied_embeddings_share_table(self):
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=40, target_vocab_size=40, max_position=64,
+            tie_embeddings=True, tie_output=True, dtype="float32",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        assert params["encoder"]["embedding"]["table"] is params["decoder"]["embedding"]["table"]
+        assert "final" not in params
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 5))
+        logits, _ = transformer_apply(params, inp, inp, cfg)
+        assert logits.shape == (2, 5, 40)
+
+    def test_tied_embeddings_requires_equal_vocab(self):
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=40, target_vocab_size=48, max_position=64,
+            tie_embeddings=True,
+        )
+        with pytest.raises(ValueError):
+            transformer_init(jax.random.PRNGKey(0), cfg)
+
+    def test_decoder_only_variant(self):
+        cfg = ModelConfig(
+            num_layers=2, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=48, target_vocab_size=48, max_position=64,
+            decoder_only=True, tie_output=True, dtype="float32",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        assert "encoder" not in params
+        assert "cross_mha" not in params["decoder"]["layers"][0]
+        toks = tokens(jax.random.PRNGKey(1), 48, (2, 10))
+        logits, _ = transformer_apply(params, None, toks, cfg)
+        assert logits.shape == (2, 10, 48)
+
+    def test_gradients_flow_everywhere(self):
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 5))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 4))
+
+        def loss(p):
+            logits, _ = transformer_apply(p, inp, tar, TINY)
+            return jnp.mean(logits**2)
+
+        grads = jax.grad(loss)(params)
+        flat, _ = jax.tree_util.tree_flatten(grads)
+        assert all(bool(jnp.any(g != 0)) for g in flat)
+
+
+class TestKVCacheDecode:
+    def test_cached_decode_matches_full_forward(self):
+        """Each cached step's logits must equal the corresponding position of a
+        full (non-cached) forward pass — the correctness contract that lets us
+        replace the reference's O(S²) re-decode (train.py:109-118)."""
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 6))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 5))
+        full_logits, _ = transformer_apply(params, inp, tar, TINY)
+
+        from transformer_tpu.models.encoder import encoder_apply as enc_apply
+
+        enc_mask = make_padding_mask(inp)
+        enc_out, _ = enc_apply(params["encoder"], inp, enc_mask, TINY)
+        caches = init_decoder_caches(TINY, 2, 8)
+        # compute_dtype is fp32 in TINY, so caches already match.
+        for t in range(5):
+            step_logits, caches = transformer_decode_step(
+                params, tar[:, t : t + 1], enc_out, enc_mask, caches,
+                jnp.array(t, jnp.int32), TINY,
+            )
+            np.testing.assert_allclose(
+                np.asarray(step_logits), np.asarray(full_logits[:, t, :]), atol=2e-4
+            )

@@ -1,0 +1,186 @@
+"""Unit tests for core ops (L1) against NumPy oracles (SURVEY.md §4 plan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.ops import (
+    dot_product_attention,
+    ffn_apply,
+    ffn_init,
+    make_causal_mask,
+    make_padding_mask,
+    make_seq2seq_masks,
+    mha_apply,
+    mha_init,
+    sinusoidal_positional_encoding,
+)
+from transformer_tpu.ops.attention import init_cache
+from transformer_tpu.ops.masks import NEG_INF, attention_bias
+from transformer_tpu.ops.nn import layernorm_apply, layernorm_init
+
+
+class TestPositionalEncoding:
+    def test_matches_closed_form(self):
+        """Oracle: the reference formula (positionalencoding.py:4-23) in NumPy —
+        block layout [sin(angles at even channels), cos(angles at odd channels)]."""
+        max_pos, d_model = 64, 16
+        table = np.asarray(sinusoidal_positional_encoding(max_pos, d_model))
+        pos = np.arange(max_pos)[:, None]
+        i = np.arange(d_model)[None, :]
+        angles = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
+        expected = np.concatenate([np.sin(angles[:, 0::2]), np.cos(angles[:, 1::2])], axis=-1)
+        np.testing.assert_allclose(table, expected, atol=1e-5)
+
+    def test_sized_by_positions_not_vocab(self):
+        table = sinusoidal_positional_encoding(128, 32)
+        assert table.shape == (128, 32)
+
+    def test_position_zero_is_sin0_cos0(self):
+        table = np.asarray(sinusoidal_positional_encoding(4, 8))
+        np.testing.assert_allclose(table[0, :4], 0.0, atol=1e-7)  # sin(0)
+        np.testing.assert_allclose(table[0, 4:], 1.0, atol=1e-7)  # cos(0)
+
+
+class TestMasks:
+    def test_padding_mask(self):
+        ids = jnp.array([[5, 3, 0, 0], [1, 0, 2, 0]])
+        mask = make_padding_mask(ids)
+        assert mask.shape == (2, 1, 1, 4)
+        np.testing.assert_array_equal(
+            np.asarray(mask[:, 0, 0, :]),
+            [[True, True, False, False], [True, False, True, False]],
+        )
+
+    def test_causal_mask(self):
+        mask = np.asarray(make_causal_mask(4)[0, 0])
+        expected = np.tril(np.ones((4, 4), dtype=bool))
+        np.testing.assert_array_equal(mask, expected)
+
+    def test_seq2seq_masks_semantics(self):
+        """Parity with reference create_masks (positionalencoding.py:37-52):
+        combined = causal AND target-padding; cross mask uses *source* padding."""
+        inp = jnp.array([[7, 8, 0]])
+        tar = jnp.array([[4, 0, 5]])
+        enc, combined, cross = make_seq2seq_masks(inp, tar)
+        assert enc.shape == (1, 1, 1, 3)
+        assert combined.shape == (1, 1, 3, 3)
+        assert cross.shape == (1, 1, 1, 3)
+        np.testing.assert_array_equal(np.asarray(enc[0, 0, 0]), [True, True, False])
+        np.testing.assert_array_equal(np.asarray(cross[0, 0, 0]), [True, True, False])
+        # Row 2 (query pos 2): causal allows 0,1,2 but key pos 1 is pad.
+        np.testing.assert_array_equal(np.asarray(combined[0, 0, 2]), [True, False, True])
+        # Row 0: only key 0.
+        np.testing.assert_array_equal(np.asarray(combined[0, 0, 0]), [True, False, False])
+
+    def test_attention_bias(self):
+        mask = jnp.array([[True, False]])
+        bias = np.asarray(attention_bias(mask, jnp.float32))
+        assert bias[0, 0] == 0.0 and bias[0, 1] == NEG_INF
+
+
+def _numpy_attention(q, k, v, allowed=None):
+    """fp64 NumPy oracle for softmax(qk^T/sqrt(d))v over (B,S,H,D) layout."""
+    q, k, v = (np.asarray(t, dtype=np.float64) for t in (q, k, v))
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    if allowed is not None:
+        logits = np.where(np.asarray(allowed), logits, -1e9)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+class TestDotProductAttention:
+    def test_matches_numpy_oracle(self):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (2, 5, 3, 8))
+        k = jax.random.normal(kk, (2, 7, 3, 8))
+        v = jax.random.normal(kv, (2, 7, 3, 8))
+        out, _ = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), _numpy_attention(q, k, v), atol=1e-5)
+
+    def test_masking_blocks_positions(self):
+        key = jax.random.PRNGKey(1)
+        q = jax.random.normal(key, (1, 2, 1, 4))
+        k = jax.random.normal(key, (1, 3, 1, 4))
+        v = jax.random.normal(key, (1, 3, 1, 4))
+        mask = jnp.array([True, True, False])[None, None, None, :]
+        out, w = dot_product_attention(q, k, v, mask, return_weights=True)
+        np.testing.assert_allclose(np.asarray(w[..., 2]), 0.0, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(out), _numpy_attention(q, k, v, mask), atol=1e-5
+        )
+
+    def test_weights_sum_to_one(self):
+        q = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 2, 8))
+        _, w = dot_product_attention(q, q, q, return_weights=True)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, atol=1e-6)
+
+    def test_bf16_inputs_fp32_softmax(self):
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 8, 2, 16), dtype=jnp.bfloat16)
+        out, _ = dot_product_attention(q, q, q)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float64),
+            _numpy_attention(q, q, q),
+            atol=2e-2,
+        )
+
+
+class TestMultiHeadAttention:
+    def test_shapes_and_param_structure(self):
+        cfg = ModelConfig(d_model=32, num_heads=4, input_vocab_size=10, target_vocab_size=10)
+        params = mha_init(jax.random.PRNGKey(0), cfg.d_model, cfg.num_heads)
+        assert params["query"]["kernel"].shape == (32, 4, 8)
+        assert params["out"]["kernel"].shape == (4, 8, 32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 32))
+        out, w, _ = mha_apply(params, x, x, return_weights=True)
+        assert out.shape == (2, 6, 32)
+        assert w.shape == (2, 4, 6, 6)
+
+    def test_divisibility_asserted(self):
+        with pytest.raises(ValueError):
+            ModelConfig(d_model=30, num_heads=4)
+
+    def test_cache_decode_matches_full_attention(self):
+        """Greedy-decode equivalence: attending step-by-step through a KV cache
+        must equal causal attention over the full sequence."""
+        d_model, heads, seq = 16, 2, 5
+        params = mha_init(jax.random.PRNGKey(0), d_model, heads)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, seq, d_model))
+        full, _, _ = mha_apply(params, x, x, causal=True)
+
+        cache = init_cache(1, seq, heads, d_model // heads, dtype=jnp.float32)
+        outs = []
+        for t in range(seq):
+            step, _, cache = mha_apply(params, x[:, t : t + 1], x[:, t : t + 1], cache=cache)
+            outs.append(step)
+        incremental = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(incremental), atol=1e-5)
+
+
+class TestFFN:
+    def test_matches_numpy_oracle(self):
+        params = ffn_init(jax.random.PRNGKey(0), 8, 16)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
+        out = ffn_apply(params, x)
+        h = np.maximum(np.asarray(x) @ np.asarray(params["in"]["kernel"]) + np.asarray(params["in"]["bias"]), 0)
+        expected = h @ np.asarray(params["out"]["kernel"]) + np.asarray(params["out"]["bias"])
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+class TestLayerNorm:
+    def test_matches_numpy_oracle(self):
+        params = layernorm_init(16)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16)) * 3 + 1
+        out = np.asarray(layernorm_apply(params, x))
+        xn = np.asarray(x, dtype=np.float64)
+        expected = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(
+            xn.var(-1, keepdims=True) + 1e-6
+        )
+        np.testing.assert_allclose(out, expected, atol=1e-4)
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)

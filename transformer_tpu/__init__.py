@@ -1,0 +1,27 @@
+"""transformer_tpu — a TPU-native (JAX/XLA/Pallas/pjit) Transformer framework.
+
+A from-scratch rebuild of the capabilities of the reference TF2.0 framework
+(kuetuofa/Transformer): encoder-decoder Transformer for seq2seq translation,
+single-chip and distributed (data/tensor/sequence-parallel) training, a subword
+text pipeline, a training engine with noam-schedule Adam, masked cross-entropy,
+checkpoint rotation/restore, metrics, greedy decoding and model export.
+
+Design stance (see SURVEY.md §7): functional core — pure ``init``/``apply``
+functions over parameter pytrees, a mesh-aware training engine driven by
+``jax.sharding`` annotations, and Pallas kernels for the hot attention path.
+Nothing here is a translation of the reference's Keras class graph.
+"""
+
+from transformer_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MeshConfig",
+    "ModelConfig",
+    "TrainConfig",
+]

@@ -1,0 +1,165 @@
+"""Configuration for models, training, and device meshes.
+
+The reference keeps its entire configuration as a flat absl-flags namespace of
+15 knobs (reference ``utils.py:17-33`` plus ``distributed_train.py:23``). Here
+the same capability surface is three frozen dataclasses — model / training /
+mesh — so configs are hashable (usable as jit static args), serializable, and
+composable. The CLI layer (``transformer_tpu/cli``) still exposes the
+reference's flag names for drop-in familiarity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+
+# Special-token convention, matching the reference pipeline (``utils.py:137-143``):
+# pad = 0; BOS = subword_vocab_size; EOS = subword_vocab_size + 1, so a model's
+# embedding table has subword_vocab_size + 2 rows (reference ``train.py:232-233``).
+PAD_ID = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of one Transformer (encoder-decoder or decoder-only).
+
+    Defaults mirror the reference flag defaults (``utils.py:18-33``):
+    4 layers, d_model=512, dff=1024, 4 heads, dropout 0.1.
+    """
+
+    num_layers: int = 4
+    d_model: int = 512
+    num_heads: int = 4
+    dff: int = 1024
+    input_vocab_size: int = 32000
+    target_vocab_size: int = 32000
+    dropout_rate: float = 0.1
+    # Positional table sized by max positions — deliberately fixing the
+    # reference's vocab-sized table (SURVEY.md §2.3.5; reference ``Encoder.py:40``).
+    max_position: int = 4096
+    # Post-LN matches the reference residual wiring (``Encoder.py:19-29``);
+    # "pre" is offered because pre-LN is markedly more stable at depth.
+    norm_scheme: str = "post"  # "post" | "pre"
+    layernorm_epsilon: float = 1e-6
+    # BASELINE.json configs[3]: tied src/tgt embeddings and tied output projection.
+    tie_embeddings: bool = False  # share encoder/decoder embedding tables
+    tie_output: bool = False  # logits = h @ embedding.T instead of a fresh Dense
+    # BASELINE.json configs[4]: decoder-only causal LM (no encoder, no cross-attn).
+    decoder_only: bool = False
+    # Activation in the pointwise FFN; reference uses relu (``point_ffn.py:5``).
+    ffn_activation: str = "relu"  # "relu" | "gelu" | "silu"
+    # Compute dtype: bf16 keeps the MXU fed at full rate; params stay fp32.
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # Attention implementation: "xla" (einsum softmax einsum, XLA-fused),
+    # "flash" (Pallas blockwise kernel), "ring" (sequence-parallel ring over ICI).
+    attention_impl: str = "xla"
+    # Block sizes for the Pallas flash-attention kernel.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
+
+    def __post_init__(self) -> None:
+        if self.d_model % self.num_heads != 0:
+            # Same invariant the reference asserts (``Attention.py:42``).
+            raise ValueError(
+                f"d_model ({self.d_model}) must be divisible by num_heads "
+                f"({self.num_heads})"
+            )
+        if self.norm_scheme not in ("post", "pre"):
+            raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
+        if self.ffn_activation not in ("relu", "gelu", "silu"):
+            raise ValueError(f"unknown ffn_activation {self.ffn_activation!r}")
+        if self.attention_impl not in ("xla", "flash", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @property
+    def params_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.param_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training-engine knobs; defaults mirror the reference (``utils.py:18-33``,
+    ``train.py:21-22,65-66``)."""
+
+    batch_size: int = 64
+    sequence_length: int = 50
+    epochs: int = 4
+    # Noam schedule warmup. The reference defaults to 60000 (``train.py:22``),
+    # not the paper's 4000 — kept as the default for parity.
+    warmup_steps: int = 60000
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.98
+    adam_epsilon: float = 1e-9
+    label_smoothing: float = 0.0  # BASELINE.json configs[2] uses > 0
+    # "tokens": mean CE over non-pad tokens (the sane default).
+    # "batch": sum of per-token CE divided by global batch size — the
+    # reference's exact normalization (``train.py:83-88``), offered for parity.
+    loss_normalization: str = "tokens"
+    max_grad_norm: float = 0.0  # 0 disables clipping (reference has none)
+    buffer_size: int = 100000  # shuffle buffer (reference ``utils.py:19``)
+    eval_every_steps: int = 500
+    log_every_steps: int = 100
+    checkpoint_every_epochs: int = 5  # intent of the reference's (buggy) save cond
+    max_ckpt_keep: int = 5
+    ckpt_path: str = "model_dist"
+    enable_function: bool = True  # jit on/off — the reference's eager-debug flag
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.loss_normalization not in ("tokens", "batch"):
+            raise ValueError(
+                f"loss_normalization must be 'tokens' or 'batch', got {self.loss_normalization!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axis names are the framework-wide vocabulary used
+    by every PartitionSpec:
+
+    - ``data``: data parallelism (gradient psum over ICI — the TPU-native
+      replacement for the reference's NCCL all-reduce, ``distributed_train.py:58-62``)
+    - ``fsdp``: parameter/optimizer sharding (zero-style), rides the data axis
+    - ``model``: tensor parallelism (attention heads / dff)
+    - ``seq``: sequence/context parallelism (ring attention over ICI)
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.model * self.seq
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", "fsdp", "model", "seq")
+
+    @property
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.model, self.seq)
+
+
+def config_to_json(cfg: Any) -> str:
+    """Serialize any of the config dataclasses to JSON (for export/checkpoints)."""
+    return json.dumps(dataclasses.asdict(cfg), indent=2, sort_keys=True)
+
+
+def config_from_json(cls: type, payload: str | Mapping[str, Any]):
+    data = json.loads(payload) if isinstance(payload, str) else dict(payload)
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in data.items() if k in known})

@@ -1,0 +1,33 @@
+"""Model layers and assembly (L2/L3): encoder/decoder stacks and the
+Transformer — counterpart of the reference's ``Encoder.py`` / ``Decoder.py`` /
+``Transformer.py``, as pure init/apply functions over parameter pytrees."""
+
+from transformer_tpu.models.decoder import (
+    decoder_apply,
+    decoder_init,
+    decoder_layer_apply,
+    decoder_layer_init,
+)
+from transformer_tpu.models.encoder import (
+    encoder_apply,
+    encoder_init,
+    encoder_layer_apply,
+    encoder_layer_init,
+)
+from transformer_tpu.models.transformer import (
+    transformer_apply,
+    transformer_init,
+)
+
+__all__ = [
+    "decoder_apply",
+    "decoder_init",
+    "decoder_layer_apply",
+    "decoder_layer_init",
+    "encoder_apply",
+    "encoder_init",
+    "encoder_layer_apply",
+    "encoder_layer_init",
+    "transformer_apply",
+    "transformer_init",
+]

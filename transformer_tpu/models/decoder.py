@@ -1,0 +1,167 @@
+"""Decoder layer and stack (also serves as the decoder-only causal LM trunk).
+
+Counterpart of the reference's ``Decoder.py``: three post-LN sublayers — masked
+self-attention, cross-attention with v=k=encoder output and q=decoder state
+(``Decoder.py:29-36``), and FFN — behind the shared embed prologue. Extensions
+beyond the reference:
+
+- ``cfg.decoder_only`` drops the cross-attention sublayer entirely
+  (BASELINE.json configs[4], the 4096-token causal LM);
+- per-layer KV caches make autoregressive decode O(S) instead of the
+  reference's O(S²) full re-run per step (``train.py:109-118``);
+- causality is passed structurally (``causal=True``) so the flash/ring
+  kernels can skip above-diagonal blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.ops.attention import init_cache, mha_apply, mha_init
+from transformer_tpu.ops.ffn import ffn_apply, ffn_init
+from transformer_tpu.ops.nn import (
+    Params,
+    embedding_init,
+    layernorm_apply,
+    layernorm_init,
+)
+from transformer_tpu.models.encoder import _sublayer, embed_prologue
+
+
+def decoder_layer_init(key: jax.Array, cfg: ModelConfig) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    params: Params = {
+        "self_mha": mha_init(k1, cfg.d_model, cfg.num_heads, cfg.params_dtype),
+        "ffn": ffn_init(k3, cfg.d_model, cfg.dff, cfg.params_dtype),
+        "ln1": layernorm_init(cfg.d_model, cfg.params_dtype),
+        "ln_ffn": layernorm_init(cfg.d_model, cfg.params_dtype),
+    }
+    if not cfg.decoder_only:
+        params["cross_mha"] = mha_init(k2, cfg.d_model, cfg.num_heads, cfg.params_dtype)
+        params["ln2"] = layernorm_init(cfg.d_model, cfg.params_dtype)
+    return params
+
+
+def decoder_layer_apply(
+    params: Params,
+    x: jax.Array,
+    enc_out: jax.Array | None,
+    self_mask: jax.Array | None,
+    cross_mask: jax.Array | None,
+    cfg: ModelConfig,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    return_weights: bool = False,
+    cache: dict[str, Any] | None = None,
+) -> tuple[jax.Array, jax.Array | None, jax.Array | None, dict[str, Any] | None]:
+    """Returns (x, self_attn_weights, cross_attn_weights, updated_cache)."""
+    r1, r2, r3 = (None, None, None) if rng is None else jax.random.split(rng, 3)
+    boxes: list[Any] = [None, None, None]
+
+    def self_attn(h):
+        out, w, new_cache = mha_apply(
+            params["self_mha"], h, h, self_mask,
+            impl=cfg.attention_impl,
+            causal=cache is None,  # cache path builds its own prefix mask
+            return_weights=return_weights,
+            cache=cache,
+            flash_block_q=cfg.flash_block_q,
+            flash_block_k=cfg.flash_block_k,
+        )
+        boxes[0], boxes[2] = w, new_cache
+        return out
+
+    x = _sublayer(cfg, params["ln1"], x, self_attn, r1, deterministic)
+
+    if not cfg.decoder_only:
+        if enc_out is None:
+            raise ValueError("encoder output required unless cfg.decoder_only")
+
+        def cross_attn(h):
+            # q = decoder state, k = v = encoder output (reference ``Decoder.py:33-36``).
+            out, w, _ = mha_apply(
+                params["cross_mha"], h, enc_out, cross_mask,
+                return_weights=return_weights,
+            )
+            boxes[1] = w
+            return out
+
+        x = _sublayer(cfg, params["ln2"], x, cross_attn, r2, deterministic)
+
+    x = _sublayer(
+        cfg, params["ln_ffn"], x,
+        lambda h: ffn_apply(params["ffn"], h, cfg.ffn_activation),
+        r3, deterministic,
+    )
+    return x, boxes[0], boxes[1], boxes[2]
+
+
+def decoder_init(key: jax.Array, cfg: ModelConfig, embedding: Params | None = None) -> Params:
+    """``embedding`` may be a shared table (``cfg.tie_embeddings``) — the pytree
+    then simply references the same arrays; jit dedups the constant."""
+    keys = jax.random.split(key, cfg.num_layers + 1)
+    params: Params = {
+        "embedding": embedding
+        if embedding is not None
+        else embedding_init(keys[0], cfg.target_vocab_size, cfg.d_model, cfg.params_dtype),
+        "layers": [decoder_layer_init(keys[i + 1], cfg) for i in range(cfg.num_layers)],
+    }
+    if cfg.norm_scheme == "pre":
+        params["final_ln"] = layernorm_init(cfg.d_model, cfg.params_dtype)
+    return params
+
+
+def decoder_apply(
+    params: Params,
+    ids: jax.Array,
+    enc_out: jax.Array | None,
+    self_mask: jax.Array | None,
+    cross_mask: jax.Array | None,
+    cfg: ModelConfig,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    return_weights: bool = False,
+    caches: list[dict[str, Any]] | None = None,
+    position_offset: jax.Array | int = 0,
+) -> tuple[jax.Array, dict[str, jax.Array], list[dict[str, Any]] | None]:
+    """(B, S) ids -> (B, S, d_model). Attention maps are keyed
+    ``decoder_layer{i}_block{1,2}`` for parity with the reference's dict
+    (``Decoder.py:75-76``)."""
+    rngs = (
+        [None] * (cfg.num_layers + 1)
+        if rng is None
+        else list(jax.random.split(rng, cfg.num_layers + 1))
+    )
+    x = embed_prologue(
+        params["embedding"], ids, cfg, rngs[0], deterministic, position_offset
+    )
+    attn_weights: dict[str, jax.Array] = {}
+    new_caches: list[dict[str, Any]] | None = [] if caches is not None else None
+    for i, layer in enumerate(params["layers"]):
+        x, w1, w2, new_cache = decoder_layer_apply(
+            layer, x, enc_out, self_mask, cross_mask, cfg,
+            rngs[i + 1], deterministic, return_weights,
+            cache=None if caches is None else caches[i],
+        )
+        if w1 is not None:
+            attn_weights[f"decoder_layer{i + 1}_block1"] = w1
+        if w2 is not None:
+            attn_weights[f"decoder_layer{i + 1}_block2"] = w2
+        if new_caches is not None:
+            new_caches.append(new_cache)
+    if cfg.norm_scheme == "pre":
+        x = layernorm_apply(params["final_ln"], x, cfg.layernorm_epsilon)
+    return x, attn_weights, new_caches
+
+
+def init_decoder_caches(
+    cfg: ModelConfig, batch_size: int, max_len: int
+) -> list[dict[str, Any]]:
+    """One self-attention KV cache per decoder layer."""
+    return [
+        init_cache(batch_size, max_len, cfg.num_heads, cfg.head_dim, cfg.compute_dtype)
+        for _ in range(cfg.num_layers)
+    ]

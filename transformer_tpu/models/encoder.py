@@ -1,0 +1,150 @@
+"""Encoder layer and stack.
+
+Counterpart of the reference's ``Encoder.py``: a post-LN residual block
+(``LN(x + Drop(MHA(x)))`` then ``LN(h + Drop(FFN(h)))``, ``Encoder.py:19-29``)
+stacked N deep behind an embed/scale/posenc/dropout prologue
+(``Encoder.py:48-60``). Differences by design:
+
+- optional pre-LN wiring (``norm_scheme="pre"``) for deep/long-context configs;
+- the positional table is sized by ``max_position``, not vocab size
+  (fixes SURVEY.md §2.3.5);
+- dropout threads an explicit rng and a static ``deterministic`` flag instead
+  of Keras's stateful ``training=`` mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.ops.attention import mha_apply, mha_init
+from transformer_tpu.ops.ffn import ffn_apply, ffn_init
+from transformer_tpu.ops.nn import (
+    Params,
+    dropout,
+    embedding_init,
+    embedding_lookup,
+    layernorm_apply,
+    layernorm_init,
+)
+from transformer_tpu.ops.positional import sinusoidal_positional_encoding
+
+
+def encoder_layer_init(key: jax.Array, cfg: ModelConfig) -> Params:
+    k_mha, k_ffn = jax.random.split(key)
+    return {
+        "mha": mha_init(k_mha, cfg.d_model, cfg.num_heads, cfg.params_dtype),
+        "ffn": ffn_init(k_ffn, cfg.d_model, cfg.dff, cfg.params_dtype),
+        "ln1": layernorm_init(cfg.d_model, cfg.params_dtype),
+        "ln2": layernorm_init(cfg.d_model, cfg.params_dtype),
+    }
+
+
+def _sublayer(cfg: ModelConfig, params_ln, x, fn, rng, deterministic):
+    """Residual sublayer in post-LN (reference wiring) or pre-LN form."""
+    if cfg.norm_scheme == "pre":
+        y = fn(layernorm_apply(params_ln, x, cfg.layernorm_epsilon))
+        y = dropout(rng, y, cfg.dropout_rate, deterministic)
+        return x + y
+    y = fn(x)
+    y = dropout(rng, y, cfg.dropout_rate, deterministic)
+    return layernorm_apply(params_ln, x + y, cfg.layernorm_epsilon)
+
+
+def encoder_layer_apply(
+    params: Params,
+    x: jax.Array,
+    mask: jax.Array | None,
+    cfg: ModelConfig,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    return_weights: bool = False,
+) -> tuple[jax.Array, jax.Array | None]:
+    r1, r2 = (None, None) if rng is None else jax.random.split(rng)
+    weights_box = [None]
+
+    def attn(h):
+        out, w, _ = mha_apply(
+            params["mha"], h, h, mask,
+            impl=cfg.attention_impl,
+            return_weights=return_weights,
+            flash_block_q=cfg.flash_block_q,
+            flash_block_k=cfg.flash_block_k,
+        )
+        weights_box[0] = w
+        return out
+
+    x = _sublayer(cfg, params["ln1"], x, attn, r1, deterministic)
+    x = _sublayer(
+        cfg, params["ln2"], x,
+        lambda h: ffn_apply(params["ffn"], h, cfg.ffn_activation),
+        r2, deterministic,
+    )
+    return x, weights_box[0]
+
+
+def encoder_init(key: jax.Array, cfg: ModelConfig) -> Params:
+    keys = jax.random.split(key, cfg.num_layers + 1)
+    params: Params = {
+        "embedding": embedding_init(keys[0], cfg.input_vocab_size, cfg.d_model, cfg.params_dtype),
+        "layers": [encoder_layer_init(keys[i + 1], cfg) for i in range(cfg.num_layers)],
+    }
+    if cfg.norm_scheme == "pre":
+        params["final_ln"] = layernorm_init(cfg.d_model, cfg.params_dtype)
+    return params
+
+
+def embed_prologue(
+    embedding: Params,
+    ids: jax.Array,
+    cfg: ModelConfig,
+    rng: jax.Array | None,
+    deterministic: bool,
+    position_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Shared embed → ×√d_model → +posenc → dropout prologue
+    (reference ``Encoder.py:51-55`` / ``Decoder.py:65-69``). ``position_offset``
+    supports KV-cache decode, where the current token sits at a nonzero
+    absolute position."""
+    seq_len = ids.shape[1]
+    if seq_len > cfg.max_position:
+        raise ValueError(
+            f"sequence length {seq_len} exceeds cfg.max_position "
+            f"{cfg.max_position}; raise max_position to size the positional table"
+        )
+    x = embedding_lookup(embedding, ids, cfg.compute_dtype)
+    x = x * jnp.asarray(cfg.d_model**0.5, dtype=cfg.compute_dtype)
+    table = sinusoidal_positional_encoding(cfg.max_position, cfg.d_model, cfg.compute_dtype)
+    pos = jax.lax.dynamic_slice_in_dim(table, position_offset, seq_len, axis=0)
+    x = x + pos[None, :, :]
+    return dropout(rng, x, cfg.dropout_rate, deterministic)
+
+
+def encoder_apply(
+    params: Params,
+    ids: jax.Array,
+    mask: jax.Array | None,
+    cfg: ModelConfig,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    return_weights: bool = False,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """(B, S) ids -> (B, S, d_model) encodings plus (optionally) per-layer
+    attention maps keyed like the reference's dict (``Decoder.py:75-76`` style)."""
+    rngs = (
+        [None] * (cfg.num_layers + 1)
+        if rng is None
+        else list(jax.random.split(rng, cfg.num_layers + 1))
+    )
+    x = embed_prologue(params["embedding"], ids, cfg, rngs[0], deterministic)
+    attn_weights: dict[str, jax.Array] = {}
+    for i, layer in enumerate(params["layers"]):
+        x, w = encoder_layer_apply(
+            layer, x, mask, cfg, rngs[i + 1], deterministic, return_weights
+        )
+        if w is not None:
+            attn_weights[f"encoder_layer{i + 1}"] = w
+    if cfg.norm_scheme == "pre":
+        x = layernorm_apply(params["final_ln"], x, cfg.layernorm_epsilon)
+    return x, attn_weights

@@ -1,0 +1,110 @@
+"""Transformer assembly.
+
+Counterpart of the reference's ``Transformer.py``: encoder + decoder + final
+vocab projection, with masks rebuilt from raw token ids inside the forward pass
+every call (``Transformer.py:21-23``). Extensions beyond the reference:
+
+- ``cfg.tie_embeddings``: one shared embedding table for source and target
+  (requires equal vocab sizes) — BASELINE.json configs[3];
+- ``cfg.tie_output``: logits via the transposed embedding table instead of the
+  reference's untied Dense (``Transformer.py:16,30``);
+- ``cfg.decoder_only``: a causal LM with no encoder at all — forward takes the
+  token sequence alone (BASELINE.json configs[4]).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from transformer_tpu.config import PAD_ID, ModelConfig
+from transformer_tpu.models.decoder import decoder_apply, decoder_init
+from transformer_tpu.models.encoder import encoder_apply, encoder_init
+from transformer_tpu.ops.masks import make_padding_mask, make_seq2seq_masks
+from transformer_tpu.ops.nn import Params, dense_apply, dense_init, embedding_attend
+
+
+def transformer_init(key: jax.Array, cfg: ModelConfig) -> Params:
+    k_enc, k_dec, k_final = jax.random.split(key, 3)
+    if cfg.decoder_only:
+        params: Params = {"decoder": decoder_init(k_dec, cfg)}
+    else:
+        encoder = encoder_init(k_enc, cfg)
+        shared = None
+        if cfg.tie_embeddings:
+            if cfg.input_vocab_size != cfg.target_vocab_size:
+                raise ValueError(
+                    "tie_embeddings requires input_vocab_size == target_vocab_size "
+                    f"({cfg.input_vocab_size} != {cfg.target_vocab_size})"
+                )
+            shared = encoder["embedding"]
+        params = {"encoder": encoder, "decoder": decoder_init(k_dec, cfg, embedding=shared)}
+    if not cfg.tie_output:
+        params["final"] = dense_init(
+            k_final, cfg.d_model, cfg.target_vocab_size, cfg.params_dtype
+        )
+    return params
+
+
+def _logits(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    if cfg.tie_output:
+        return embedding_attend(params["decoder"]["embedding"], x)
+    return dense_apply(params["final"], x)
+
+
+def transformer_apply(
+    params: Params,
+    inp: jax.Array | None,
+    tar: jax.Array,
+    cfg: ModelConfig,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    return_weights: bool = False,
+    pad_id: int = PAD_ID,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Forward pass: (inp, tar) token ids -> (logits, attention_weights).
+
+    ``inp`` is ignored (may be None) when ``cfg.decoder_only``; ``tar`` is then
+    the causal-LM token sequence. Logits are raw (no softmax), shaped
+    (B, S_tgt, target_vocab_size) — same contract as reference
+    ``Transformer.py:30-32``.
+    """
+    if cfg.decoder_only:
+        self_mask = make_padding_mask(tar, pad_id)  # ANDed with causal inside MHA
+        x, attn, _ = decoder_apply(
+            params["decoder"], tar, None, self_mask, None, cfg,
+            rng, deterministic, return_weights,
+        )
+        return _logits(params, x, cfg), attn
+
+    enc_mask, combined_mask, cross_mask = make_seq2seq_masks(inp, tar, pad_id)
+    r_enc, r_dec = (None, None) if rng is None else jax.random.split(rng)
+    enc_out, enc_attn = encoder_apply(
+        params["encoder"], inp, enc_mask, cfg, r_enc, deterministic, return_weights
+    )
+    x, dec_attn, _ = decoder_apply(
+        params["decoder"], tar, enc_out, combined_mask, cross_mask, cfg,
+        r_dec, deterministic, return_weights,
+    )
+    return _logits(params, x, cfg), {**enc_attn, **dec_attn}
+
+
+def transformer_decode_step(
+    params: Params,
+    token: jax.Array,
+    enc_out: jax.Array | None,
+    cross_mask: jax.Array | None,
+    caches: list[dict[str, Any]],
+    position: jax.Array,
+    cfg: ModelConfig,
+) -> tuple[jax.Array, list[dict[str, Any]]]:
+    """One KV-cached autoregressive step: (B, 1) token -> (B, vocab) next-token
+    logits plus updated caches. This replaces the reference's full re-encode +
+    re-decode per generated token (``train.py:110``)."""
+    x, _, new_caches = decoder_apply(
+        params["decoder"], token, enc_out, None, cross_mask, cfg,
+        rng=None, deterministic=True, caches=caches, position_offset=position,
+    )
+    logits = _logits(params, x, cfg)
+    return logits[:, -1, :], new_caches

@@ -1,0 +1,33 @@
+"""Core ops (L1): attention math, FFN, positional encoding, masks, primitives.
+
+The TPU-native counterpart of the reference's ``Attention.py`` /
+``point_ffn.py`` / ``positionalencoding.py``: pure functions over parameter
+pytrees, traced once under jit and fused by XLA.
+"""
+
+from transformer_tpu.ops.attention import (
+    dot_product_attention,
+    mha_apply,
+    mha_init,
+)
+from transformer_tpu.ops.ffn import ffn_apply, ffn_init
+from transformer_tpu.ops.masks import (
+    attention_bias,
+    make_causal_mask,
+    make_padding_mask,
+    make_seq2seq_masks,
+)
+from transformer_tpu.ops.positional import sinusoidal_positional_encoding
+
+__all__ = [
+    "attention_bias",
+    "dot_product_attention",
+    "ffn_apply",
+    "ffn_init",
+    "make_causal_mask",
+    "make_padding_mask",
+    "make_seq2seq_masks",
+    "mha_apply",
+    "mha_init",
+    "sinusoidal_positional_encoding",
+]

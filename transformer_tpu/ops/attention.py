@@ -1,0 +1,186 @@
+"""Scaled dot-product attention and multi-head attention.
+
+The TPU-native counterpart of the reference's ``Attention.py``:
+
+- ``scaled_dot_product_attention`` (``Attention.py:3-34``) becomes
+  ``dot_product_attention``: two einsums around an fp32 softmax, with the mask
+  applied as an additive bias. XLA fuses the scale/bias/softmax chain; the
+  matmuls land on the MXU.
+- ``MultiHeadAttention`` (``Attention.py:36-78``) becomes ``mha_init`` /
+  ``mha_apply`` over a parameter pytree. Instead of the reference's four
+  ``d_model -> d_model`` Dense layers plus reshape/transpose
+  (``Attention.py:46-57``), projections map directly ``d_model -> (heads,
+  head_dim)`` via one einsum — no transposes in the hot path, and the ``heads``
+  axis is a real array axis that tensor parallelism shards on the ``model``
+  mesh axis.
+
+Activation layout is (batch, seq, heads, head_dim) throughout.
+
+Call convention: ``mha_apply(params, x_q, x_kv, mask)`` — query input first.
+(The reference's positional order is ``(v, k, q, mask)``, ``Attention.py:59``;
+self-attention calls are unaffected, cross-attention callers must pass
+query=decoder state, kv=encoder output.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.ops.masks import attention_bias
+from transformer_tpu.ops.nn import Params, glorot_uniform
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    return_weights: bool = False,
+) -> tuple[jax.Array, jax.Array | None]:
+    """softmax(q·kᵀ/√d + bias)·v for (B, S, H, D) tensors.
+
+    Matches the math of reference ``Attention.py:20-32``. The softmax runs in
+    fp32 even when inputs are bf16 — exp/sum in bf16 loses enough precision to
+    move BLEU. Returns ``(output, weights)`` where ``weights`` is the
+    (B, H, S_q, S_k) attention map when ``return_weights`` else None (the
+    reference always returns it, ``Attention.py:32-34``; here it is opt-in so
+    training never materializes the (B,H,S,S) tensor twice).
+    """
+    head_dim = q.shape[-1]
+    scale = head_dim**-0.5
+    # (B, S_q, H, D) x (B, S_k, H, D) -> (B, H, S_q, S_k)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = logits + attention_bias(mask, dtype=jnp.float32)
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights_c = weights.astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights_c, v)
+    return out, (weights if return_weights else None)
+
+
+def mha_init(
+    key: jax.Array,
+    d_model: int,
+    num_heads: int,
+    param_dtype=jnp.float32,
+) -> Params:
+    """Parameters for multi-head attention: q/k/v projections shaped
+    (d_model, heads, head_dim) and an output projection (heads, head_dim,
+    d_model). Same parameter count as the reference's four Dense layers
+    (``Attention.py:46-50``) — just pre-split by head."""
+    head_dim = d_model // num_heads
+    kq, kk, kv, ko = jax.random.split(key, 4)
+
+    def proj(k):
+        w = glorot_uniform(k, (d_model, d_model), param_dtype, d_model, d_model)
+        return w.reshape(d_model, num_heads, head_dim)
+
+    return {
+        "query": {"kernel": proj(kq), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
+        "key": {"kernel": proj(kk), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
+        "value": {"kernel": proj(kv), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
+        "out": {
+            "kernel": glorot_uniform(ko, (d_model, d_model), param_dtype, d_model, d_model)
+            .reshape(d_model, num_heads, head_dim)
+            .transpose(1, 2, 0),
+            "bias": jnp.zeros((d_model,), param_dtype),
+        },
+    }
+
+
+def _project(p: Params, x: jax.Array, dtype) -> jax.Array:
+    # (B, S, M) @ (M, H, D) -> (B, S, H, D)
+    return jnp.einsum("bsm,mhd->bshd", x.astype(dtype), p["kernel"].astype(dtype)) + p[
+        "bias"
+    ].astype(dtype)
+
+
+def mha_apply(
+    params: Params,
+    x_q: jax.Array,
+    x_kv: jax.Array,
+    mask: jax.Array | None = None,
+    *,
+    impl: str = "xla",
+    causal: bool = False,
+    return_weights: bool = False,
+    cache: dict[str, Any] | None = None,
+    flash_block_q: int = 128,
+    flash_block_k: int = 128,
+) -> tuple[jax.Array, jax.Array | None, dict[str, Any] | None]:
+    """Multi-head attention forward.
+
+    Args:
+      params: pytree from ``mha_init``.
+      x_q: (B, S_q, d_model) query-side input.
+      x_kv: (B, S_k, d_model) key/value-side input (same as ``x_q`` for
+        self-attention; encoder output for cross-attention).
+      mask: broadcastable bool allowed-mask (B|1, 1|H, S_q|1, S_k).
+      impl: "xla" | "flash" (Pallas blockwise kernel; causal/full, no weights).
+      causal: pass causality structurally so the flash kernel can skip blocks
+        above the diagonal instead of masking them.
+      cache: optional decode KV cache ``{"k","v","index"}`` with k/v shaped
+        (B, max_len, H, D); when given, S_q is the number of new positions
+        (1 for greedy decode), new k/v are written at ``index`` and attention
+        runs over the filled prefix. Returns the updated cache.
+
+    Returns ``(out, weights|None, cache|None)``.
+    """
+    dtype = x_q.dtype
+    q = _project(params["query"], x_q, dtype)
+    k = _project(params["key"], x_kv, dtype)
+    v = _project(params["value"], x_kv, dtype)
+
+    if cache is not None:
+        idx = cache["index"]
+        max_len = cache["k"].shape[1]
+        k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
+        v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
+        cache = {"k": k, "v": v, "index": idx + x_q.shape[1]}
+        # Decode-step mask: attend to positions < index + s_q, combined with
+        # any padding mask the caller provided.
+        positions = jnp.arange(max_len)[None, None, None, :]
+        valid = positions < (idx + x_q.shape[1])
+        mask = valid if mask is None else jnp.logical_and(mask, valid)
+        k = k.astype(dtype)
+        v = v.astype(dtype)
+
+    if impl == "flash" and cache is None:
+        from transformer_tpu.kernels.flash_attention import flash_attention
+
+        out = flash_attention(
+            q, k, v,
+            mask=None if causal and mask is None else mask,
+            causal=causal,
+            block_q=flash_block_q,
+            block_k=flash_block_k,
+        )
+        weights = None
+    else:
+        if causal and mask is None and cache is None:
+            from transformer_tpu.ops.masks import make_causal_mask
+
+            mask = make_causal_mask(x_q.shape[1])
+        out, weights = dot_product_attention(q, k, v, mask, return_weights=return_weights)
+
+    merged = jnp.einsum(
+        "bshd,hdm->bsm", out, params["out"]["kernel"].astype(dtype)
+    ) + params["out"]["bias"].astype(dtype)
+    return merged, weights, cache
+
+
+def init_cache(
+    batch_size: int, max_len: int, num_heads: int, head_dim: int, dtype=jnp.bfloat16
+) -> dict[str, Any]:
+    """Fresh decode cache. The reference instead re-runs the full decoder over
+    a concat-grown buffer every step (``train.py:109-118``) — a recompile bomb
+    under XLA; a fixed-size cache plus ``dynamic_update_slice`` keeps decode a
+    single compiled program."""
+    return {
+        "k": jnp.zeros((batch_size, max_len, num_heads, head_dim), dtype=dtype),
+        "v": jnp.zeros((batch_size, max_len, num_heads, head_dim), dtype=dtype),
+        "index": jnp.array(0, dtype=jnp.int32),
+    }

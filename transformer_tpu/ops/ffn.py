@@ -1,0 +1,35 @@
+"""Position-wise feed-forward network.
+
+Counterpart of the reference's ``point_wise_feed_forward_network``
+(``point_ffn.py:3-7``): Dense(dff, act) -> Dense(d_model), relu by default.
+Two MXU matmuls with the activation fused between them by XLA. The ``dff``
+axis is the tensor-parallel shard axis (column-parallel first matmul,
+row-parallel second).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.ops.nn import Params, dense_apply, dense_init
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+}
+
+
+def ffn_init(key: jax.Array, d_model: int, dff: int, param_dtype=jnp.float32) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {
+        "in": dense_init(k1, d_model, dff, param_dtype),
+        "out": dense_init(k2, dff, d_model, param_dtype),
+    }
+
+
+def ffn_apply(params: Params, x: jax.Array, activation: str = "relu") -> jax.Array:
+    act = _ACTIVATIONS[activation]
+    h = act(dense_apply(params["in"], x))
+    return dense_apply(params["out"], h)

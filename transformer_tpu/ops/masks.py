@@ -1,0 +1,70 @@
+"""Attention-mask construction.
+
+Capability parity with the reference's ``positionalencoding.py:25-52``
+(``create_padding_mask`` / ``create_look_ahead_mask`` / ``create_masks``) with
+one deliberate semantic flip: here a mask is **boolean with True = "may
+attend"** (the JAX-ecosystem convention), converted to an additive bias right
+at the attention op. The reference instead uses float masks where 1.0 means
+"blocked" and adds ``mask * -1e9`` (``Attention.py:26``). The resulting
+attention patterns are identical; the boolean form fuses cleanly under XLA and
+feeds block-granular masking in the Pallas kernels.
+
+Masks are built from raw token ids inside the forward pass, exactly like the
+reference (``Transformer.py:23``) — they are not part of the data pipeline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.config import PAD_ID
+
+# Large-negative constant used for additive masking. Finite (not -inf) so that
+# fully-masked rows produce a uniform softmax instead of NaNs — same approach
+# as the reference's -1e9 (``Attention.py:26``).
+NEG_INF = -1e9
+
+
+def make_padding_mask(ids: jax.Array, pad_id: int = PAD_ID) -> jax.Array:
+    """(B, S) int ids -> (B, 1, 1, S) bool, True where the key position is a
+    real token (reference ``create_padding_mask``, ``positionalencoding.py:25-30``,
+    with the blocked/allowed polarity flipped as documented above)."""
+    allowed = ids != pad_id
+    return allowed[:, None, None, :]
+
+
+def make_causal_mask(seq_len: int) -> jax.Array:
+    """(1, 1, S, S) bool, True where query position i may attend key position
+    j<=i (reference ``create_look_ahead_mask``, ``positionalencoding.py:32-34``)."""
+    mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
+    return mask[None, None, :, :]
+
+
+def make_seq2seq_masks(
+    inp: jax.Array, tar: jax.Array, pad_id: int = PAD_ID
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The three masks of an encoder-decoder step (reference ``create_masks``,
+    ``positionalencoding.py:37-52``):
+
+    - ``enc_mask``    (B,1,1,S_src): encoder self-attention padding mask.
+    - ``combined``    (B,1,S_tgt,S_tgt): decoder self-attention — causal AND
+      target-padding (the reference's ``tf.maximum`` of blocked-masks is a
+      logical-AND of allowed-masks).
+    - ``cross_mask``  (B,1,1,S_src): decoder cross-attention mask over the
+      *encoder* keys (source padding).
+    """
+    enc_mask = make_padding_mask(inp, pad_id)
+    causal = make_causal_mask(tar.shape[1])
+    tgt_pad = make_padding_mask(tar, pad_id)
+    combined = jnp.logical_and(causal, tgt_pad)
+    cross_mask = make_padding_mask(inp, pad_id)
+    return enc_mask, combined, cross_mask
+
+
+def attention_bias(mask: jax.Array | None, dtype=jnp.float32) -> jax.Array | None:
+    """Boolean allowed-mask -> additive bias (0 where allowed, NEG_INF where
+    blocked), in the requested compute dtype."""
+    if mask is None:
+        return None
+    return jnp.where(mask, jnp.zeros((), dtype=dtype), jnp.asarray(NEG_INF, dtype=dtype))

@@ -1,0 +1,85 @@
+"""Primitive neural-net building blocks: dense, embedding, layernorm, dropout.
+
+Functional style: ``*_init(key, ...) -> params`` (a dict pytree of jnp arrays)
+and ``*_apply(params, x, ...) -> y``. Parameters live in ``param_dtype``
+(fp32 by default); compute casts to the caller's ``dtype`` (bf16 on TPU so the
+MXU runs at full rate).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+def glorot_uniform(key: jax.Array, shape: tuple[int, ...], dtype, fan_in: int, fan_out: int):
+    """Glorot/Xavier uniform — the initializer the reference inherits from
+    ``tf.keras.layers.Dense`` defaults (reference ``Attention.py:46-50``,
+    ``point_ffn.py:4-6``)."""
+    limit = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+def dense_init(key: jax.Array, d_in: int, d_out: int, dtype=jnp.float32) -> Params:
+    return {
+        "kernel": glorot_uniform(key, (d_in, d_out), dtype, d_in, d_out),
+        "bias": jnp.zeros((d_out,), dtype=dtype),
+    }
+
+
+def dense_apply(params: Params, x: jax.Array, dtype=None) -> jax.Array:
+    dtype = dtype or x.dtype
+    kernel = params["kernel"].astype(dtype)
+    bias = params["bias"].astype(dtype)
+    return jnp.matmul(x.astype(dtype), kernel) + bias
+
+
+def embedding_init(key: jax.Array, vocab_size: int, d_model: int, dtype=jnp.float32) -> Params:
+    # Normal(0, 1) scaled down — standard for transformer embeddings that are
+    # multiplied by sqrt(d_model) in the stack prologue (reference ``Encoder.py:52``).
+    table = jax.random.normal(key, (vocab_size, d_model), dtype=dtype) * (d_model**-0.5)
+    return {"table": table}
+
+
+def embedding_lookup(params: Params, ids: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    return jnp.take(params["table"].astype(dtype), ids, axis=0)
+
+
+def embedding_attend(params: Params, x: jax.Array) -> jax.Array:
+    """Tied output projection: logits = x @ table.T (BASELINE.json configs[3])."""
+    table = params["table"].astype(x.dtype)
+    return jnp.matmul(x, table.T)
+
+
+def layernorm_init(d: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((d,), dtype=dtype), "bias": jnp.zeros((d,), dtype=dtype)}
+
+
+def layernorm_apply(params: Params, x: jax.Array, epsilon: float = 1e-6) -> jax.Array:
+    """LayerNorm with the reference's epsilon=1e-6 (``Encoder.py:13-14``).
+
+    Statistics are computed in fp32 regardless of the compute dtype — bf16
+    variance is numerically unsafe — then the result is cast back.
+    """
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+    out = normed * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+    return out.astype(orig_dtype)
+
+
+def dropout(key: jax.Array | None, x: jax.Array, rate: float, deterministic: bool) -> jax.Array:
+    """Inverted dropout. ``deterministic=True`` (eval) or rate==0 is identity —
+    and both must be decided at trace time (static), never via data-dependent
+    control flow inside jit."""
+    if deterministic or rate == 0.0:
+        return x
+    if key is None:
+        raise ValueError("dropout in training mode requires an rng key")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

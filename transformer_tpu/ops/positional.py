@@ -1,0 +1,35 @@
+"""Sinusoidal positional encoding.
+
+Counterpart of the reference's ``positionalencoding.py:4-23``, computed with
+jnp closed-form (traceable, constant-folded by XLA) instead of eager NumPy at
+module-construction time. The table is sized by **max positions**, fixing the
+reference's quirk of sizing it by vocab size (~32k rows; ``Encoder.py:40``,
+SURVEY.md §2.3.5).
+
+Layout matches the reference: the first d_model/2 channels carry sin of the
+even-index angle frequencies and the last d_model/2 carry cos of the odd-index
+frequencies, concatenated block-wise (``positionalencoding.py:19``) rather than
+interleaved. Any self-consistent layout trains identically; the block layout is
+also the friendlier one for rotary-style slicing later.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sinusoidal_positional_encoding(
+    max_position: int, d_model: int, dtype=jnp.float32
+) -> jax.Array:
+    """Return (max_position, d_model) table: pe[p] = [sin(p/10000^(2i/d)) for
+    even i] ++ [cos(p/10000^(2i/d)) for odd i] (reference ``get_angles``,
+    ``positionalencoding.py:4-6``)."""
+    positions = jnp.arange(max_position, dtype=jnp.float32)[:, None]  # (P, 1)
+    channels = jnp.arange(d_model, dtype=jnp.float32)[None, :]  # (1, D)
+    angle_rates = jnp.power(10000.0, -(2.0 * jnp.floor(channels / 2.0)) / d_model)
+    angles = positions * angle_rates  # (P, D)
+    evens = angles[:, 0::2]
+    odds = angles[:, 1::2]
+    table = jnp.concatenate([jnp.sin(evens), jnp.cos(odds)], axis=-1)
+    return table.astype(dtype)
