@@ -170,6 +170,23 @@ class TestTransformer:
         logits, _ = transformer_apply(params, None, toks, cfg)
         assert logits.shape == (2, 10, 48)
 
+    def test_decoder_only_is_causal_with_padding_mask(self):
+        """Regression: causality must hold even when a padding mask is passed
+        (the padding mask must be ANDed with causal, not replace it)."""
+        cfg = ModelConfig(
+            num_layers=2, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=48, target_vocab_size=48, max_position=64,
+            decoder_only=True, tie_output=True, dtype="float32", dropout_rate=0.0,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        t1 = jnp.array([[3, 4, 5, 6, 0, 0]])  # padded row: mask is non-trivial
+        t2 = jnp.array([[3, 4, 5, 9, 0, 0]])  # token 3 changed
+        l1, _ = transformer_apply(params, None, t1, cfg)
+        l2, _ = transformer_apply(params, None, t2, cfg)
+        np.testing.assert_allclose(
+            np.asarray(l1[:, :3]), np.asarray(l2[:, :3]), atol=1e-6
+        )
+
     def test_gradients_flow_everywhere(self):
         params = transformer_init(jax.random.PRNGKey(0), TINY)
         inp = tokens(jax.random.PRNGKey(1), 40, (2, 5))

@@ -145,6 +145,29 @@ class TestMultiHeadAttention:
         with pytest.raises(ValueError):
             ModelConfig(d_model=30, num_heads=4)
 
+    def test_cache_prefill_chunk_is_causal(self):
+        """Regression: writing a multi-token chunk into the cache must stay
+        causal — query i may not attend new positions > i."""
+        d_model, heads, seq = 16, 2, 6
+        params = mha_init(jax.random.PRNGKey(0), d_model, heads)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, seq, d_model))
+        full, _, _ = mha_apply(params, x, x, causal=True)
+        cache = init_cache(1, seq, heads, d_model // heads, dtype=jnp.float32)
+        chunk, _, cache = mha_apply(params, x[:, :4], x[:, :4], cache=cache)
+        np.testing.assert_allclose(np.asarray(full[:, :4]), np.asarray(chunk), atol=1e-5)
+        step, _, cache = mha_apply(params, x[:, 4:], x[:, 4:], cache=cache)
+        np.testing.assert_allclose(np.asarray(full[:, 4:]), np.asarray(step), atol=1e-5)
+
+    def test_causal_flag_combines_with_padding_mask(self):
+        """causal=True must AND with a provided mask, not be skipped."""
+        d_model, heads = 8, 1
+        params = mha_init(jax.random.PRNGKey(0), d_model, heads)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 4, d_model))
+        pad_mask = jnp.ones((1, 1, 1, 4), jnp.bool_)
+        _, w, _ = mha_apply(params, x, x, pad_mask, causal=True, return_weights=True)
+        w = np.asarray(w[0, 0])
+        assert np.allclose(np.triu(w, k=1), 0.0, atol=1e-6), "future positions attended"
+
     def test_cache_decode_matches_full_attention(self):
         """Greedy-decode equivalence: attending step-by-step through a KV cache
         must equal causal attention over the full sequence."""

@@ -1,0 +1,259 @@
+"""Training-engine tests (SURVEY.md §4 plan): schedule curve, loss masking,
+label smoothing, checkpoint round-trip + rotation, overfit-one-batch
+integration, greedy decode EOS semantics, TensorBoard wire format, BLEU."""
+
+import math
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transformer_tpu.config import ModelConfig, TrainConfig
+from transformer_tpu.models import transformer_init
+from transformer_tpu.train import (
+    CheckpointManager,
+    create_train_state,
+    greedy_decode,
+    make_eval_step,
+    make_train_step,
+    masked_cross_entropy,
+    noam_schedule,
+)
+from transformer_tpu.train.checkpoint import export_params, load_exported_params
+from transformer_tpu.train.decode import translate
+from transformer_tpu.utils.bleu import corpus_bleu
+from transformer_tpu.utils.tensorboard import SummaryWriter, _masked_crc
+
+TINY = ModelConfig(
+    num_layers=1, d_model=16, num_heads=2, dff=32,
+    input_vocab_size=30, target_vocab_size=30, max_position=32, dtype="float32",
+    dropout_rate=0.0,
+)
+TCFG = TrainConfig(batch_size=4, sequence_length=8, epochs=1, warmup_steps=100)
+
+
+class TestSchedule:
+    def test_noam_curve(self):
+        """Closed-form check: rises linearly to warmup, then decays as
+        rsqrt(step) (reference train.py:30-34)."""
+        sched = noam_schedule(d_model=512, warmup_steps=4000)
+        s = np.asarray([sched(i) for i in [0, 999, 3999, 7999, 99999]])
+        # linear region: lr(1000)/lr(4000) ≈ 1000/4000
+        np.testing.assert_allclose(s[1] / s[2], 1000 / 4000, rtol=1e-4)
+        # peak at warmup boundary
+        expected_peak = 512**-0.5 * 4000**-0.5
+        np.testing.assert_allclose(s[2], expected_peak, rtol=1e-4)
+        # decay region: lr ∝ step^-0.5
+        np.testing.assert_allclose(s[3] / s[4], (100000 / 8000) ** 0.5, rtol=1e-3)
+
+    def test_warmup_default_matches_reference(self):
+        assert TrainConfig().warmup_steps == 60000
+
+
+class TestLoss:
+    def test_pad_positions_contribute_zero(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 10))
+        targets = jnp.array([[1, 2, 0, 0], [3, 0, 0, 0]])
+        loss, m = masked_cross_entropy(logits, targets)
+        assert float(m["weight"]) == 3.0
+        # changing logits at pad positions must not change the loss
+        logits2 = logits.at[:, 2:, :].add(100.0)
+        loss2, _ = masked_cross_entropy(logits2, targets)
+        np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
+
+    def test_matches_numpy_oracle(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 5))
+        targets = jnp.array([[1, 2, 3], [4, 1, 0]])
+        loss, _ = masked_cross_entropy(logits, targets)
+        lp = np.asarray(jax.nn.log_softmax(logits, -1), dtype=np.float64)
+        t = np.asarray(targets)
+        per = -lp[np.arange(2)[:, None], np.arange(3)[None, :], t]
+        mask = t != 0
+        np.testing.assert_allclose(float(loss), per[mask].mean(), rtol=1e-5)
+
+    def test_batch_normalization_parity(self):
+        """'batch' mode reproduces the reference rule: sum/batch_size
+        (train.py:88)."""
+        logits = jax.random.normal(jax.random.PRNGKey(2), (4, 3, 5))
+        targets = jnp.ones((4, 3), jnp.int32)
+        loss, m = masked_cross_entropy(
+            logits, targets, normalization="batch", batch_size=4
+        )
+        np.testing.assert_allclose(float(loss), float(m["loss_sum"]) / 4, rtol=1e-6)
+
+    def test_label_smoothing_raises_loss_on_confident_model(self):
+        logits = jnp.full((1, 2, 5), -10.0).at[..., 1].set(10.0)
+        targets = jnp.ones((1, 2), jnp.int32)
+        sharp, _ = masked_cross_entropy(logits, targets)
+        smooth, _ = masked_cross_entropy(logits, targets, label_smoothing=0.1)
+        assert float(smooth) > float(sharp)
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=3, is_primary=True)
+        state2 = create_train_state(jax.random.PRNGKey(1), TINY, TCFG)
+        mgr.save(state, step=7)
+        restored = mgr.restore_latest(state2)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_rotation_keeps_max(self, tmp_path):
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2, is_primary=True)
+        for s in [1, 2, 3, 4]:
+            mgr.save(state, step=s)
+        assert mgr.all_steps() == [3, 4]
+
+    def test_restore_latest_none_when_empty(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2, is_primary=True)
+        assert mgr.restore_latest(None) is None
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        mgr = CheckpointManager(str(tmp_path), is_primary=True)
+        mgr.save(state, step=1)
+        other = create_train_state(
+            jax.random.PRNGKey(0),
+            ModelConfig(
+                num_layers=1, d_model=32, num_heads=2, dff=32,
+                input_vocab_size=30, target_vocab_size=30, max_position=32,
+                dtype="float32",
+            ),
+            TCFG,
+        )
+        with pytest.raises(ValueError):
+            mgr.restore(other, 1)
+
+    def test_export_load(self, tmp_path):
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        export_params(params, TINY, str(tmp_path / "export"))
+        template = transformer_init(jax.random.PRNGKey(1), TINY)
+        loaded = load_exported_params(str(tmp_path / "export"), template)
+        np.testing.assert_array_equal(
+            np.asarray(loaded["encoder"]["embedding"]["table"]),
+            np.asarray(params["encoder"]["embedding"]["table"]),
+        )
+
+
+class TestTrainStep:
+    def test_overfit_one_batch(self):
+        """Integration: loss falls by >60% in 150 steps on a fixed batch."""
+        tcfg = TrainConfig(
+            batch_size=4, sequence_length=8, epochs=1,
+            warmup_steps=20, loss_normalization="tokens",
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tcfg)
+        step = jax.jit(make_train_step(TINY, tcfg))
+        src = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 1, 30)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (4, 8), 1, 30)
+        rng = jax.random.PRNGKey(3)
+        first = last = None
+        for _ in range(150):
+            state, m = step(state, src, tgt, rng)
+            if first is None:
+                first = float(m["loss"])
+            last = float(m["loss"])
+        assert last < 0.4 * first, (first, last)
+        assert int(state.step) == 150
+
+    def test_eval_step_deterministic(self):
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        eval_step = jax.jit(make_eval_step(TINY, TCFG))
+        src = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 1, 30)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (4, 8), 1, 30)
+        m1 = eval_step(state, src, tgt)
+        m2 = eval_step(state, src, tgt)
+        assert float(m1["loss"]) == float(m2["loss"])
+
+
+class TestGreedyDecode:
+    def test_shapes_and_pad_after_eos(self):
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        src = jax.random.randint(jax.random.PRNGKey(1), (3, 6), 1, 30)
+        out = np.asarray(greedy_decode(params, src, TINY, 10, bos_id=28, eos_id=29))
+        assert out.shape == (3, 10)
+        for row in out:
+            seen_eos = False
+            for t in row:
+                if seen_eos:
+                    assert t == 0
+                if t == 29:
+                    seen_eos = True
+
+    def test_translate_accepts_str_and_list(self):
+        """The reference's predict(str) decodes one character (quirk §2.3.11);
+        both spellings must work here."""
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+        tok = SubwordTokenizer.build_from_corpus(
+            ["ab cd ef"] * 3, target_vocab_size=270
+        )
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=tok.model_vocab_size,
+            target_vocab_size=tok.model_vocab_size,
+            max_position=32, dtype="float32", dropout_rate=0.0,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        single = translate(params, cfg, tok, tok, "ab cd", max_len=5)
+        double = translate(params, cfg, tok, tok, ["ab cd", "ef"], max_len=5)
+        assert len(single) == 1 and len(double) == 2
+        assert all(isinstance(t, str) for t in double)
+
+
+class TestTensorBoardWriter:
+    def test_record_framing_and_crc(self, tmp_path):
+        w = SummaryWriter(str(tmp_path))
+        w.scalar("loss", 1.5, step=3)
+        w.close()
+        data = open(w.path, "rb").read()
+        # record 1: file_version; record 2: our scalar
+        off = 0
+        records = []
+        while off < len(data):
+            (length,) = struct.unpack_from("<Q", data, off)
+            (len_crc,) = struct.unpack_from("<I", data, off + 8)
+            assert len_crc == _masked_crc(data[off : off + 8])
+            payload = data[off + 12 : off + 12 + length]
+            (payload_crc,) = struct.unpack_from("<I", data, off + 12 + length)
+            assert payload_crc == _masked_crc(payload)
+            records.append(payload)
+            off += 12 + length + 4
+        assert len(records) == 2
+        assert b"brain.Event:2" in records[0]
+        assert b"loss" in records[1]
+        assert struct.pack("<f", 1.5) in records[1]
+
+    def test_crc32c_known_vector(self):
+        from transformer_tpu.utils.tensorboard import _crc32c
+
+        # RFC 3720 test vector: 32 zero bytes -> 0x8A9136AA
+        assert _crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+class TestBleu:
+    def test_perfect_match_is_100(self):
+        refs = ["the cat sat on the mat", "hello world foo bar"]
+        assert corpus_bleu(refs, refs, smooth=False) == pytest.approx(100.0)
+
+    def test_zero_overlap_is_0(self):
+        assert corpus_bleu(["a b c d"], ["x y z w"], smooth=False) == 0.0
+
+    def test_brevity_penalty(self):
+        refs = ["a b c d e f g h"]
+        full = corpus_bleu(refs, ["a b c d e f g h"])
+        short = corpus_bleu(refs, ["a b c d"])
+        assert short < full
+        # BP formula: exp(1 - ref/hyp)
+        assert short == pytest.approx(
+            100 * math.exp(1 - 8 / 4) * math.exp(
+                (math.log(4 / 4) + math.log(4 / 4) + math.log(3 / 3) + math.log(2 / 2)) / 4
+            ),
+            rel=1e-6,
+        )

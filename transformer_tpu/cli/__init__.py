@@ -1,0 +1,3 @@
+"""CLI entry points — counterparts of the reference's ``train.py`` /
+``distributed_train.py`` absl entry points, preserving the reference flag
+names (``utils.py:17-33``) plus TPU-native mesh knobs."""

@@ -1,0 +1,108 @@
+"""Flag surface.
+
+Preserves the reference's 16-flag namespace verbatim (``utils.py:17-33``:
+dataset_path, buffer_size, src_vocab_file, tgt_vocab_file, sequence_length,
+epochs, batch_size, per_replica_batch_size, num_layers, d_model, dff,
+num_heads, enable_function, max_ckpt_keep, ckpt_path, dropout_rate) and adds
+the TPU-native knobs (mesh axes, dtype, platform, variants). ``flags_to_*``
+materialize the namespace into the framework's config dataclasses — the
+counterpart of ``flags_dict()`` + ``main(**kwargs)`` splatting
+(``utils.py:36-62``, ``train.py:216-220``).
+"""
+
+from __future__ import annotations
+
+from absl import flags
+
+from transformer_tpu.config import MeshConfig, ModelConfig, TrainConfig
+
+FLAGS = flags.FLAGS
+
+
+def define_flags() -> None:
+    # --- reference-surface flags (utils.py:18-33 defaults) ---
+    flags.DEFINE_string("dataset_path", "data", "directory with src/tgt line files")
+    flags.DEFINE_integer("buffer_size", 100000, "shuffle buffer (compat; full-shuffle used)")
+    flags.DEFINE_string("src_vocab_file", "src_vocab.subwords", "source subword vocab path")
+    flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords", "target subword vocab path")
+    flags.DEFINE_integer("sequence_length", 50, "max sequence length (tokens incl. BOS/EOS)")
+    flags.DEFINE_integer("epochs", 4, "training epochs")
+    flags.DEFINE_integer("batch_size", 64, "global batch size")
+    flags.DEFINE_integer("per_replica_batch_size", 16, "compat flag; derived from batch_size/mesh")
+    flags.DEFINE_integer("num_layers", 4, "transformer layers per stack")
+    flags.DEFINE_integer("d_model", 512, "model width")
+    flags.DEFINE_integer("dff", 1024, "FFN hidden width")
+    flags.DEFINE_integer("num_heads", 4, "attention heads")
+    flags.DEFINE_boolean("enable_function", True, "jit the train/eval steps (False = eager debug)")
+    flags.DEFINE_integer("max_ckpt_keep", 5, "checkpoints to retain")
+    flags.DEFINE_string("ckpt_path", "model_dist", "checkpoint directory")
+    flags.DEFINE_float("dropout_rate", 0.1, "dropout rate")
+    # --- framework extensions ---
+    flags.DEFINE_integer("target_vocab_size", 2**15, "subword vocab build target")
+    flags.DEFINE_integer("warmup_steps", 60000, "noam warmup steps")
+    flags.DEFINE_float("label_smoothing", 0.0, "label smoothing epsilon")
+    flags.DEFINE_enum("loss_normalization", "tokens", ["tokens", "batch"],
+                      "CE normalization ('batch' = reference rule)")
+    flags.DEFINE_float("max_grad_norm", 0.0, "global-norm gradient clip (0 = off)")
+    flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
+    flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
+    flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
+    flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring"], "attention kernel")
+    flags.DEFINE_string("dtype", "bfloat16", "compute dtype")
+    flags.DEFINE_string("tb_log_dir", "logs", "TensorBoard log root")
+    flags.DEFINE_integer("seed", 0, "PRNG seed")
+    flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    # --- mesh knobs (distributed) ---
+    flags.DEFINE_integer("dp", 0, "data-parallel mesh size (0 = all devices)")
+    flags.DEFINE_integer("fsdp", 1, "fsdp (param-shard) mesh size")
+    flags.DEFINE_integer("tp", 1, "tensor-parallel mesh size")
+    flags.DEFINE_integer("sp", 1, "sequence-parallel mesh size")
+
+
+def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> ModelConfig:
+    return ModelConfig(
+        num_layers=FLAGS.num_layers,
+        d_model=FLAGS.d_model,
+        num_heads=FLAGS.num_heads,
+        dff=FLAGS.dff,
+        input_vocab_size=input_vocab_size,
+        target_vocab_size=target_vocab_size,
+        dropout_rate=FLAGS.dropout_rate,
+        max_position=max(FLAGS.sequence_length, 64),
+        norm_scheme=FLAGS.norm_scheme,
+        tie_embeddings=FLAGS.tie_embeddings,
+        tie_output=FLAGS.tie_output,
+        ffn_activation="relu",
+        dtype=FLAGS.dtype,
+        attention_impl=FLAGS.attention_impl,
+    )
+
+
+def flags_to_train_config() -> TrainConfig:
+    return TrainConfig(
+        batch_size=FLAGS.batch_size,
+        sequence_length=FLAGS.sequence_length,
+        epochs=FLAGS.epochs,
+        warmup_steps=FLAGS.warmup_steps,
+        label_smoothing=FLAGS.label_smoothing,
+        loss_normalization=FLAGS.loss_normalization,
+        max_grad_norm=FLAGS.max_grad_norm,
+        buffer_size=FLAGS.buffer_size,
+        max_ckpt_keep=FLAGS.max_ckpt_keep,
+        ckpt_path=FLAGS.ckpt_path,
+        enable_function=FLAGS.enable_function,
+        seed=FLAGS.seed,
+    )
+
+
+def flags_to_mesh_config(n_devices: int) -> MeshConfig:
+    non_dp = FLAGS.fsdp * FLAGS.tp * FLAGS.sp
+    dp = FLAGS.dp or max(1, n_devices // non_dp)
+    return MeshConfig(data=dp, fsdp=FLAGS.fsdp, model=FLAGS.tp, seq=FLAGS.sp)
+
+
+def maybe_force_platform() -> None:
+    if FLAGS.platform:
+        import jax
+
+        jax.config.update("jax_platforms", FLAGS.platform)

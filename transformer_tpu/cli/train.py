@@ -1,0 +1,89 @@
+"""Single-device training entry point.
+
+Counterpart of the reference's ``python train.py`` (``train.py:216-251``):
+load data → build model → train → restore → sample greedy decode → export.
+Run:
+
+    python -m transformer_tpu.cli.train --dataset_path=data --epochs=4
+
+Differences by design (SURVEY.md §2.3 fixes): restore happens *before*
+training; the demo decode uses target-tokenizer specials, stops on EOS and
+detokenizes; checkpoints save on the intended cadence.
+"""
+
+from __future__ import annotations
+
+import os
+
+from absl import app, flags, logging
+
+from transformer_tpu.cli.flags import (
+    define_flags,
+    flags_to_mesh_config,
+    flags_to_model_config,
+    flags_to_train_config,
+    maybe_force_platform,
+)
+
+FLAGS = flags.FLAGS
+
+
+def main(argv) -> None:
+    del argv
+    maybe_force_platform()
+    import jax
+
+    from transformer_tpu.data import load_dataset
+    from transformer_tpu.train import CheckpointManager, Trainer, create_train_state
+    from transformer_tpu.train.checkpoint import export_params
+    from transformer_tpu.train.decode import translate
+
+    train_cfg = flags_to_train_config()
+    train_ds, test_ds, src_tok, tgt_tok = load_dataset(
+        FLAGS.dataset_path,
+        FLAGS.src_vocab_file,
+        FLAGS.tgt_vocab_file,
+        batch_size=train_cfg.batch_size,
+        sequence_length=train_cfg.sequence_length,
+        target_vocab_size=FLAGS.target_vocab_size,
+        seed=train_cfg.seed,
+    )
+    logging.info(
+        "data: %d train examples, vocabs %d/%d",
+        train_ds.num_examples, src_tok.vocab_size, tgt_tok.vocab_size,
+    )
+    model_cfg = flags_to_model_config(
+        src_tok.model_vocab_size, tgt_tok.model_vocab_size
+    )
+    state = create_train_state(
+        jax.random.PRNGKey(train_cfg.seed), model_cfg, train_cfg
+    )
+    ckpt = CheckpointManager(train_cfg.ckpt_path, train_cfg.max_ckpt_keep)
+    import datetime
+
+    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    trainer = Trainer(
+        model_cfg, train_cfg, state,
+        log_dir=os.path.join(FLAGS.tb_log_dir, stamp),
+        checkpoint=ckpt,
+        log_fn=logging.info,
+    )
+    trainer.fit(train_ds, test_ds)
+
+    sample = "he go to school"
+    out = translate(
+        trainer.state.params, model_cfg, src_tok, tgt_tok, sample,
+        max_len=train_cfg.sequence_length,
+    )
+    logging.info("sample translation %r -> %r", sample, out[0])
+    export_params(trainer.state.params, model_cfg, "model")
+    logging.info("exported params to ./model")
+
+
+def run() -> None:
+    define_flags()
+    app.run(main)
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,19 @@
+"""Data pipeline (L5): subword tokenizer + host-side input pipeline feeding
+device-sharded, static-shape batches — counterpart of the reference's
+``utils.py`` tfds/tf.data path."""
+
+from transformer_tpu.data.tokenizer import SubwordTokenizer
+from transformer_tpu.data.pipeline import (
+    Seq2SeqDataset,
+    load_dataset,
+    load_or_build_tokenizer,
+    read_parallel_corpus,
+)
+
+__all__ = [
+    "Seq2SeqDataset",
+    "SubwordTokenizer",
+    "load_dataset",
+    "load_or_build_tokenizer",
+    "read_parallel_corpus",
+]

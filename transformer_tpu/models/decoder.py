@@ -56,8 +56,13 @@ def decoder_layer_apply(
     deterministic: bool = True,
     return_weights: bool = False,
     cache: dict[str, Any] | None = None,
+    cross_kv: tuple[jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, jax.Array | None, jax.Array | None, dict[str, Any] | None]:
-    """Returns (x, self_attn_weights, cross_attn_weights, updated_cache)."""
+    """Returns (x, self_attn_weights, cross_attn_weights, updated_cache).
+
+    ``cross_kv`` optionally carries this layer's pre-projected encoder K/V so
+    decode steps don't re-project the static encoder output every token.
+    """
     r1, r2, r3 = (None, None, None) if rng is None else jax.random.split(rng, 3)
     boxes: list[Any] = [None, None, None]
 
@@ -85,6 +90,7 @@ def decoder_layer_apply(
             out, w, _ = mha_apply(
                 params["cross_mha"], h, enc_out, cross_mask,
                 return_weights=return_weights,
+                precomputed_kv=cross_kv,
             )
             boxes[1] = w
             return out
@@ -125,6 +131,7 @@ def decoder_apply(
     deterministic: bool = True,
     return_weights: bool = False,
     caches: list[dict[str, Any]] | None = None,
+    cross_kvs: list[tuple[jax.Array, jax.Array]] | None = None,
     position_offset: jax.Array | int = 0,
 ) -> tuple[jax.Array, dict[str, jax.Array], list[dict[str, Any]] | None]:
     """(B, S) ids -> (B, S, d_model). Attention maps are keyed
@@ -145,6 +152,7 @@ def decoder_apply(
             layer, x, enc_out, self_mask, cross_mask, cfg,
             rngs[i + 1], deterministic, return_weights,
             cache=None if caches is None else caches[i],
+            cross_kv=None if cross_kvs is None else cross_kvs[i],
         )
         if w1 is not None:
             attn_weights[f"decoder_layer{i + 1}_block1"] = w1
@@ -164,4 +172,18 @@ def init_decoder_caches(
     return [
         init_cache(batch_size, max_len, cfg.num_heads, cfg.head_dim, cfg.compute_dtype)
         for _ in range(cfg.num_layers)
+    ]
+
+
+def precompute_cross_kvs(
+    params: Params, enc_out: jax.Array, cfg: ModelConfig
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Project the (static) encoder output through every layer's cross-attention
+    K/V kernels once, so autoregressive decode attends against cached tensors
+    instead of re-projecting per generated token."""
+    from transformer_tpu.ops.attention import project_kv
+
+    return [
+        project_kv(layer["cross_mha"], enc_out, cfg.compute_dtype)
+        for layer in params["layers"]
     ]

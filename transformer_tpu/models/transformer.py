@@ -98,13 +98,16 @@ def transformer_decode_step(
     caches: list[dict[str, Any]],
     position: jax.Array,
     cfg: ModelConfig,
+    cross_kvs: list[tuple[jax.Array, jax.Array]] | None = None,
 ) -> tuple[jax.Array, list[dict[str, Any]]]:
     """One KV-cached autoregressive step: (B, 1) token -> (B, vocab) next-token
     logits plus updated caches. This replaces the reference's full re-encode +
-    re-decode per generated token (``train.py:110``)."""
+    re-decode per generated token (``train.py:110``). Pass ``cross_kvs`` from
+    ``precompute_cross_kvs`` to avoid re-projecting the encoder output."""
     x, _, new_caches = decoder_apply(
         params["decoder"], token, enc_out, None, cross_mask, cfg,
-        rng=None, deterministic=True, caches=caches, position_offset=position,
+        rng=None, deterministic=True, caches=caches, cross_kvs=cross_kvs,
+        position_offset=position,
     )
     logits = _logits(params, x, cfg)
     return logits[:, -1, :], new_caches

@@ -98,6 +98,13 @@ def _project(p: Params, x: jax.Array, dtype) -> jax.Array:
     ].astype(dtype)
 
 
+def project_kv(params: Params, x_kv: jax.Array, dtype=None) -> tuple[jax.Array, jax.Array]:
+    """Project key/value inputs once, for reuse across decode steps via
+    ``mha_apply(..., precomputed_kv=...)``."""
+    dtype = dtype or x_kv.dtype
+    return _project(params["key"], x_kv, dtype), _project(params["value"], x_kv, dtype)
+
+
 def mha_apply(
     params: Params,
     x_q: jax.Array,
@@ -108,6 +115,7 @@ def mha_apply(
     causal: bool = False,
     return_weights: bool = False,
     cache: dict[str, Any] | None = None,
+    precomputed_kv: tuple[jax.Array, jax.Array] | None = None,
     flash_block_q: int = 128,
     flash_block_k: int = 128,
 ) -> tuple[jax.Array, jax.Array | None, dict[str, Any] | None]:
@@ -125,14 +133,20 @@ def mha_apply(
       cache: optional decode KV cache ``{"k","v","index"}`` with k/v shaped
         (B, max_len, H, D); when given, S_q is the number of new positions
         (1 for greedy decode), new k/v are written at ``index`` and attention
-        runs over the filled prefix. Returns the updated cache.
+        runs causally over the filled prefix. Returns the updated cache.
+      precomputed_kv: optional (k, v) already projected to (B, S_k, H, D) —
+        used by cross-attention during decode so the static encoder output is
+        projected once, not once per generated token.
 
     Returns ``(out, weights|None, cache|None)``.
     """
     dtype = x_q.dtype
     q = _project(params["query"], x_q, dtype)
-    k = _project(params["key"], x_kv, dtype)
-    v = _project(params["value"], x_kv, dtype)
+    if precomputed_kv is not None:
+        k, v = (t.astype(dtype) for t in precomputed_kv)
+    else:
+        k = _project(params["key"], x_kv, dtype)
+        v = _project(params["value"], x_kv, dtype)
 
     if cache is not None:
         idx = cache["index"]
@@ -140,30 +154,37 @@ def mha_apply(
         k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
         v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
         cache = {"k": k, "v": v, "index": idx + x_q.shape[1]}
-        # Decode-step mask: attend to positions < index + s_q, combined with
-        # any padding mask the caller provided.
+        # Causal decode mask over the cache buffer: new query at absolute
+        # position idx+i may attend keys at positions <= idx+i (prefill with
+        # s_q > 1 stays causal), combined with any caller-provided mask.
         positions = jnp.arange(max_len)[None, None, None, :]
-        valid = positions < (idx + x_q.shape[1])
+        q_pos = idx + jnp.arange(x_q.shape[1])[None, None, :, None]
+        valid = positions <= q_pos
         mask = valid if mask is None else jnp.logical_and(mask, valid)
         k = k.astype(dtype)
         v = v.astype(dtype)
+    elif causal:
+        # Causality is enforced whether or not a padding mask was provided.
+        from transformer_tpu.ops.masks import make_causal_mask
+
+        cmask = make_causal_mask(x_q.shape[1])
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
 
     if impl == "flash" and cache is None:
         from transformer_tpu.kernels.flash_attention import flash_attention
 
         out = flash_attention(
-            q, k, v,
-            mask=None if causal and mask is None else mask,
-            causal=causal,
+            q, k, v, mask=mask,
             block_q=flash_block_q,
             block_k=flash_block_k,
         )
         weights = None
+    elif impl == "ring" and cache is None:
+        raise NotImplementedError(
+            "attention_impl='ring' is a stack-level sequence-parallel transform; "
+            "use transformer_tpu.parallel.ring_attention inside shard_map"
+        )
     else:
-        if causal and mask is None and cache is None:
-            from transformer_tpu.ops.masks import make_causal_mask
-
-            mask = make_causal_mask(x_q.shape[1])
         out, weights = dot_product_attention(q, k, v, mask, return_weights=return_weights)
 
     merged = jnp.einsum(

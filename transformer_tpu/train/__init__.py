@@ -1,0 +1,22 @@
+"""Training engine (L4): LR schedule, loss, train/eval steps, checkpointing,
+greedy decoding, metrics — counterpart of the reference's ``train.py`` engine."""
+
+from transformer_tpu.train.schedule import noam_schedule
+from transformer_tpu.train.loss import masked_cross_entropy
+from transformer_tpu.train.state import TrainState, create_train_state, make_optimizer
+from transformer_tpu.train.trainer import Trainer, make_eval_step, make_train_step
+from transformer_tpu.train.checkpoint import CheckpointManager
+from transformer_tpu.train.decode import greedy_decode
+
+__all__ = [
+    "CheckpointManager",
+    "TrainState",
+    "Trainer",
+    "create_train_state",
+    "greedy_decode",
+    "make_eval_step",
+    "make_optimizer",
+    "make_train_step",
+    "masked_cross_entropy",
+    "noam_schedule",
+]

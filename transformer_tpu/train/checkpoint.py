@@ -1,0 +1,155 @@
+"""Checkpoint save/restore with rotation.
+
+Counterpart of the reference's ``tf.train.Checkpoint`` +
+``CheckpointManager(max_to_keep)`` + ``restore(...).expect_partial()``
+(``train.py:77-80,159-164``), as a self-contained array-tree format:
+
+    <dir>/ckpt_<step>/
+        arrays.npz      flattened {path: array} of the state pytree
+        meta.json       step, tree structure digest, configs (optional)
+
+Multi-host: only process 0 writes (TPU pods are multi-process; the reference
+is single-host and has no notion of this). Writes are atomic
+(tmp dir + rename) so a preempted save never leaves a corrupt "latest".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any
+
+import jax
+import numpy as np
+
+
+_SEP = "/"
+
+
+def _flatten(tree: Any) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _SEP.join(_path_elem(p) for p in path)
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return flat
+
+
+def _path_elem(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    if hasattr(p, "name"):
+        return str(p.name)
+    return str(p)
+
+
+class CheckpointManager:
+    """Rotated checkpoints of an arbitrary pytree keyed by its ``step``."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 5,
+        is_primary: bool | None = None,
+    ) -> None:
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.is_primary = (
+            is_primary if is_primary is not None else jax.process_index() == 0
+        )
+        if self.is_primary:
+            os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+    def save(self, state: Any, step: int | None = None) -> str | None:
+        step = int(state.step) if step is None else int(step)
+        if not self.is_primary:
+            return None
+        final = os.path.join(self.directory, f"ckpt_{step:08d}")
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        flat = _flatten(state)
+        np.savez(os.path.join(tmp, "arrays.npz"), **flat)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"step": step, "keys": sorted(flat)}, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._rotate()
+        return final
+
+    def _rotate(self) -> None:
+        steps = self.all_steps()
+        for s in steps[: -self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, f"ckpt_{s:08d}"))
+
+    def all_steps(self) -> list[int]:
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"ckpt_(\d{8})", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    @property
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # --------------------------------------------------------------- restore
+    def restore(self, target: Any, step: int) -> Any:
+        """Restore into the structure of ``target`` (arrays replaced by saved
+        values; shapes/dtypes validated). Returns a new pytree."""
+        path = os.path.join(self.directory, f"ckpt_{step:08d}", "arrays.npz")
+        with np.load(path) as data:
+            flat = {k: data[k] for k in data.files}
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
+        new_leaves = []
+        for p, leaf in leaves_with_path:
+            key = _SEP.join(_path_elem(e) for e in p)
+            if key not in flat:
+                raise KeyError(f"checkpoint missing array {key!r}")
+            saved = flat[key]
+            leaf_arr = np.asarray(leaf)
+            if saved.shape != leaf_arr.shape:
+                raise ValueError(
+                    f"{key}: checkpoint shape {saved.shape} != target {leaf_arr.shape}"
+                )
+            new_leaves.append(saved.astype(leaf_arr.dtype))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def restore_latest(self, target: Any) -> Any | None:
+        step = self.latest_step
+        if step is None:
+            return None
+        return self.restore(target, step)
+
+
+def export_params(params: Any, model_cfg, path: str) -> None:
+    """Model export for serving — the counterpart of the reference's final
+    ``tf.saved_model.save`` (``train.py:246``, README "Model Exporting"):
+    arrays.npz + config.json, loadable without the training stack."""
+    os.makedirs(path, exist_ok=True)
+    np.savez(os.path.join(path, "params.npz"), **_flatten(params))
+    from transformer_tpu.config import config_to_json
+
+    with open(os.path.join(path, "config.json"), "w") as f:
+        f.write(config_to_json(model_cfg))
+
+
+def load_exported_params(path: str, template: Any) -> Any:
+    with np.load(os.path.join(path, "params.npz")) as data:
+        flat = {k: data[k] for k in data.files}
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for p, leaf in leaves_with_path:
+        key = _SEP.join(_path_elem(e) for e in p)
+        new_leaves.append(flat[key].astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

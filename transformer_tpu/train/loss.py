@@ -1,0 +1,66 @@
+"""Loss and step metrics.
+
+Counterpart of the reference's masked cross-entropy (``train.py:67-69,83-88``):
+per-token ``SparseCategoricalCrossentropy(from_logits=True)`` with pad(0)
+positions zeroed, summed and normalized. Two normalizations are offered
+(``TrainConfig.loss_normalization``):
+
+- ``"tokens"``: mean over non-pad tokens — the sane default;
+- ``"batch"``: sum divided by global batch size — the reference's exact rule
+  (``train.py:88``), which is also the correct normalization for summed
+  per-replica losses under data parallelism (SURVEY.md §2.3.4).
+
+Plus label smoothing (BASELINE.json configs[2]), absent from the reference.
+
+Everything returns *sums* alongside the scalar loss so metric accumulation is
+exact under sharding: per-device partial sums combine with a psum that XLA
+inserts automatically when batches are sharded over the ``data`` mesh axis —
+the TPU-native replacement for Keras streaming metrics (``train.py:70-73``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.config import PAD_ID
+
+
+def masked_cross_entropy(
+    logits: jax.Array,
+    targets: jax.Array,
+    label_smoothing: float = 0.0,
+    normalization: str = "tokens",
+    batch_size: int | None = None,
+    pad_id: int = PAD_ID,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Returns ``(loss, metrics)`` where metrics carries exact sums:
+    ``loss_sum`` (fp32 summed per-token CE), ``weight`` (non-pad token count),
+    ``correct`` (argmax==target count on non-pad)."""
+    vocab = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    target_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0.0:
+        confidence = 1.0 - label_smoothing
+        uniform = label_smoothing / (vocab - 1)
+        # CE against the smoothed distribution, minus its (constant) entropy
+        # offset omitted — standard smoothed-CE used by most NMT stacks.
+        smooth_sum = jnp.sum(logp, axis=-1) - target_logp
+        per_token = -(confidence * target_logp + uniform * smooth_sum)
+    else:
+        per_token = -target_logp
+    mask = (targets != pad_id).astype(jnp.float32)
+    loss_sum = jnp.sum(per_token * mask)
+    weight = jnp.sum(mask)
+    if normalization == "tokens":
+        loss = loss_sum / jnp.maximum(weight, 1.0)
+    elif normalization == "batch":
+        if batch_size is None:
+            raise ValueError("normalization='batch' requires batch_size")
+        loss = loss_sum / float(batch_size)
+    else:
+        raise ValueError(f"unknown normalization {normalization!r}")
+    correct = jnp.sum(
+        (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32) * mask
+    )
+    return loss, {"loss_sum": loss_sum, "weight": weight, "correct": correct}

@@ -1,0 +1,25 @@
+"""Learning-rate schedules.
+
+``noam_schedule`` is the reference's ``CustomSchedule`` (``train.py:21-34``):
+``d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)`` — linear warmup to
+``warmup_steps`` then inverse-sqrt decay. The reference's default warmup is
+60000 (``train.py:22``), not the Vaswani paper's 4000.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def noam_schedule(d_model: int, warmup_steps: int = 60000):
+    """Returns ``f(step) -> lr`` usable both as an optax schedule and for
+    plotting/testing. ``step`` is 0-based from optax; the formula needs
+    1-based to avoid 0^-0.5 = inf."""
+    scale = float(d_model) ** -0.5
+    warmup = float(warmup_steps) ** -1.5
+
+    def schedule(step):
+        s = jnp.asarray(step, dtype=jnp.float32) + 1.0
+        return scale * jnp.minimum(s**-0.5, s * warmup)
+
+    return schedule

@@ -1,0 +1,56 @@
+"""Train state and optimizer construction.
+
+The state is a plain pytree dataclass — params, optimizer state, step — so it
+jits, shards with PartitionSpecs, and checkpoints as a flat array tree.
+Counterpart of the reference's ``Train.__init__`` wiring (optimizer + model
+refs, ``train.py:55-80``), without the Keras object graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from transformer_tpu.config import ModelConfig, TrainConfig
+from transformer_tpu.models import transformer_init
+from transformer_tpu.train.schedule import noam_schedule
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(model_cfg: ModelConfig, train_cfg: TrainConfig) -> optax.GradientTransformation:
+    """Adam(β1=0.9, β2=0.98, ε=1e-9) under the noam schedule — the reference's
+    optimizer exactly (``train.py:65-66``), plus optional global-norm clipping
+    (absent from the reference; off by default)."""
+    schedule = noam_schedule(model_cfg.d_model, train_cfg.warmup_steps)
+    tx = optax.adam(
+        learning_rate=schedule,
+        b1=train_cfg.adam_beta1,
+        b2=train_cfg.adam_beta2,
+        eps=train_cfg.adam_epsilon,
+    )
+    if train_cfg.max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(train_cfg.max_grad_norm), tx)
+    return tx
+
+
+def create_train_state(
+    rng: jax.Array, model_cfg: ModelConfig, train_cfg: TrainConfig
+) -> TrainState:
+    params = transformer_init(rng, model_cfg)
+    tx = make_optimizer(model_cfg, train_cfg)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+    )

@@ -1,0 +1,6 @@
+"""Utilities: TensorBoard event writing, BLEU, profiling helpers."""
+
+from transformer_tpu.utils.bleu import corpus_bleu
+from transformer_tpu.utils.tensorboard import SummaryWriter
+
+__all__ = ["SummaryWriter", "corpus_bleu"]

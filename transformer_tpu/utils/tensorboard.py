@@ -1,0 +1,110 @@
+"""Minimal TensorBoard event-file writer (no TensorFlow dependency).
+
+The reference logs loss/accuracy scalars per epoch through
+``tf.summary.create_file_writer`` (``train.py:75-76,200-206``). TensorFlow is
+not part of this stack, so this module writes the ``tfevents`` wire format
+directly: TFRecord framing (length + masked-crc32c) around hand-encoded
+``Event``/``Summary`` protobuf messages. Only scalar summaries are needed —
+the full proto surface is three fields.
+
+Files are readable by stock TensorBoard: ``events.out.tfevents.<ts>.<host>``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+# ----------------------------------------------------------------- crc32c
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- protobuf enc
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _encode_scalar_event(tag_name: str, value: float, step: int, wall_time: float) -> bytes:
+    name = tag_name.encode("utf-8")
+    summary_value = (
+        _tag(1, 2) + _varint(len(name)) + name  # Value.tag
+        + _tag(2, 5) + struct.pack("<f", value)  # Value.simple_value
+    )
+    summary = _tag(1, 2) + _varint(len(summary_value)) + summary_value  # Summary.value
+    return (
+        _tag(1, 1) + struct.pack("<d", wall_time)  # Event.wall_time
+        + _tag(2, 0) + _varint(step)  # Event.step
+        + _tag(5, 2) + _varint(len(summary)) + summary  # Event.summary
+    )
+
+
+def _encode_file_version(wall_time: float) -> bytes:
+    version = b"brain.Event:2"
+    return (
+        _tag(1, 1) + struct.pack("<d", wall_time)
+        + _tag(3, 2) + _varint(len(version)) + version  # Event.file_version
+    )
+
+
+class SummaryWriter:
+    """Append-only scalar summary writer producing stock-TensorBoard-readable
+    event files."""
+
+    def __init__(self, log_dir: str) -> None:
+        os.makedirs(log_dir, exist_ok=True)
+        ts = time.time()
+        fname = f"events.out.tfevents.{int(ts)}.{socket.gethostname()}"
+        self._path = os.path.join(log_dir, fname)
+        self._file = open(self._path, "ab")
+        self._write_record(_encode_file_version(ts))
+
+    def _write_record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._file.write(header)
+        self._file.write(struct.pack("<I", _masked_crc(header)))
+        self._file.write(payload)
+        self._file.write(struct.pack("<I", _masked_crc(payload)))
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        self._write_record(
+            _encode_scalar_event(tag, float(value), int(step), time.time())
+        )
+
+    def flush(self) -> None:
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+    @property
+    def path(self) -> str:
+        return self._path
