@@ -1,0 +1,93 @@
+"""Benchmark: Transformer-base training throughput, tokens/sec/chip.
+
+Runs the flagship train step (BASELINE.json configs[1]: 6L, d_model=512,
+8 heads, dff=2048, bf16 compute) on whatever accelerator jax exposes (the
+driver runs this on one real TPU chip), times steady-state steps, and prints
+ONE JSON line:
+
+    {"metric": "...", "value": N, "unit": "tokens/sec/chip", "vs_baseline": X}
+
+``vs_baseline`` is null: the reference publishes no numbers (BASELINE.md —
+README is a bare feature list), so there is nothing to normalize against.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from transformer_tpu.config import ModelConfig, TrainConfig
+    from transformer_tpu.train import create_train_state, make_train_step
+
+    batch, seq = 64, 64
+    model_cfg = ModelConfig(
+        num_layers=6,
+        d_model=512,
+        num_heads=8,
+        dff=2048,
+        input_vocab_size=32002,
+        target_vocab_size=32002,
+        max_position=seq,
+        dropout_rate=0.1,
+        dtype="bfloat16",
+    )
+    train_cfg = TrainConfig(
+        batch_size=batch, sequence_length=seq, warmup_steps=4000,
+    )
+
+    dev = jax.devices()[0]
+    print(f"benchmarking on {dev.platform}:{dev.device_kind}", file=sys.stderr)
+
+    state = create_train_state(jax.random.PRNGKey(0), model_cfg, train_cfg)
+    step = jax.jit(make_train_step(model_cfg, train_cfg), donate_argnums=(0,))
+    rng = jax.random.PRNGKey(1)
+    r = np.random.default_rng(0)
+    src = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
+    tgt = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
+
+    # Warmup: compile + 2 steady steps.
+    for _ in range(3):
+        state, metrics = step(state, src, tgt, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, src, tgt, rng)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    # Tokens processed per optimizer step: target tokens (the unit BLEU-side
+    # throughput is quoted in). src+tgt would double-count the same sentence.
+    tokens_per_step = batch * (seq - 1)
+    value = tokens_per_step * n_steps / dt
+
+    # Rough MFU estimate for context (stderr only): 6*P FLOPs/token fwd+bwd*3.
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    flops_per_token = 6 * n_params
+    print(
+        f"{n_steps} steps in {dt:.2f}s, {value:,.0f} tok/s, "
+        f"~{value * flops_per_token / 1e12:.2f} TFLOP/s model-flops "
+        f"({n_params / 1e6:.1f}M params)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "transformer-base train throughput (6L/512/8H/2048, bf16, batch 64, seq 64)",
+                "value": round(value, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,13 @@ class TestTokenizer:
         text = "zebra Ω 真 underscore_word"
         assert tok.decode(tok.encode(text)) == text
 
+    def test_literal_byte_token_text_roundtrips(self):
+        """Regression: literal '<0xNN>' in input text must not be confused
+        with the byte-fallback token namespace."""
+        tok = SubwordTokenizer.build_from_corpus(CORPUS, target_vocab_size=400)
+        text = "see <0x41> here < and 0x41 >"
+        assert tok.decode(tok.encode(text)) == text
+
     def test_ids_positive_and_below_vocab_size(self):
         tok = SubwordTokenizer.build_from_corpus(CORPUS, target_vocab_size=400)
         ids = tok.encode("the quick fox")
@@ -109,6 +116,24 @@ class TestDataset:
     def test_drop_remainder(self):
         ds = self._mk(n=10, batch=4)
         assert len(list(ds.batches(0))) == 2  # 10//4, remainder dropped
+
+    def test_partial_tail_batch_same_count_on_all_shards(self):
+        """Regression: with drop_remainder=False every shard must yield the
+        same number of (static-shape) batches — a short tail is padded with
+        empty rows, never skipped on one host (multi-host SPMD would hang)."""
+        kw = dict(shuffle=False, drop_remainder=False)
+        s0 = self._mk(n=10, batch=4, shard_index=0, shard_count=2, **kw)
+        s1 = self._mk(n=10, batch=4, shard_index=1, shard_count=2, **kw)
+        b0 = list(s0.batches(0))
+        b1 = list(s1.batches(0))
+        assert len(b0) == len(b1) == 3
+        for (sa, _), (sb, _) in zip(b0, b1):
+            assert sa.shape == sb.shape == (2, 10)
+        # last batch of shard 1 is entirely padding rows (weight 0)
+        assert (b1[-1][0] == 0).all()
+        # all real examples appear exactly once across shards
+        total_rows = np.concatenate([s for s, _ in b0] + [s for s, _ in b1])
+        assert (total_rows != 0).any(axis=1).sum() == 10
 
 
 class TestLoadDataset:

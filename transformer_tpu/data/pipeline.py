@@ -134,15 +134,24 @@ class Seq2SeqDataset:
         local = self.batch_size // self.shard_count
         lo = self.shard_index * local
         for start in range(0, len(order) - (self.batch_size - 1 if self.drop_remainder else 0), self.batch_size):
-            idx = order[start : start + self.batch_size][lo : lo + local]
-            if idx.size == 0:
-                continue
-            yield self._pad(idx)
+            global_idx = order[start : start + self.batch_size]
+            if len(global_idx) < self.batch_size:
+                # Final partial batch (drop_remainder=False): pad with empty
+                # (-1) rows up to the full batch size. Every shard then yields
+                # the SAME batch count and static shape — a short tail must
+                # never make one host run a step its peers skip (multi-host
+                # SPMD would deadlock), and all-pad rows carry zero metric
+                # weight so results are unchanged.
+                fill = np.full(self.batch_size - len(global_idx), -1, dtype=np.int64)
+                global_idx = np.concatenate([global_idx, fill])
+            yield self._pad(global_idx[lo : lo + local])
 
     def _pad(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         src = np.full((len(idx), self.src_len), PAD_ID, dtype=np.int32)
         tgt = np.full((len(idx), self.tgt_len), PAD_ID, dtype=np.int32)
         for row, i in enumerate(idx):
+            if i < 0:
+                continue  # padding row
             s, t = self.src[i], self.tgt[i]
             src[row, : len(s)] = s
             tgt[row, : len(t)] = t

@@ -30,6 +30,9 @@ from collections.abc import Iterable, Iterator
 _WORD_END = "_"
 _ESCAPED_UNDERSCORE = "\\u"  # literal underscore in text is escaped on encode
 _ESCAPED_BACKSLASH = "\\\\"  # literal backslash likewise (escape the escape)
+_ESCAPED_LT = "\\<"  # literal '<' escaped so text can never collide with the
+# byte-fallback token namespace "<0xNN>" (decode would otherwise reinterpret
+# literal text like "<0x41>" as byte 0x41)
 
 
 def _escape_char(ch: str) -> str:
@@ -37,6 +40,8 @@ def _escape_char(ch: str) -> str:
         return _ESCAPED_UNDERSCORE
     if ch == "\\":
         return _ESCAPED_BACKSLASH
+    if ch == "<":
+        return _ESCAPED_LT
     return ch
 
 
@@ -140,6 +145,9 @@ class SubwordTokenizer:
             elif text.startswith(_ESCAPED_UNDERSCORE, i):
                 result.append("_")
                 i += 2
+            elif text.startswith(_ESCAPED_LT, i):
+                result.append("<")
+                i += 2
             elif text[i] == _WORD_END:
                 result.append(" ")
                 i += 1
@@ -176,6 +184,7 @@ class SubwordTokenizer:
         alphabet: dict[str, None] = {_byte_token(b): None for b in range(256)}
         alphabet[_ESCAPED_UNDERSCORE] = None
         alphabet[_ESCAPED_BACKSLASH] = None
+        alphabet[_ESCAPED_LT] = None
         alphabet[_WORD_END] = None
         for sym_seq in words:
             for s in sym_seq:

@@ -127,9 +127,9 @@ def mha_apply(
       x_kv: (B, S_k, d_model) key/value-side input (same as ``x_q`` for
         self-attention; encoder output for cross-attention).
       mask: broadcastable bool allowed-mask (B|1, 1|H, S_q|1, S_k).
-      impl: "xla" | "flash" (Pallas blockwise kernel; causal/full, no weights).
-      causal: pass causality structurally so the flash kernel can skip blocks
-        above the diagonal instead of masking them.
+      impl: "xla" | "flash" (Pallas blockwise kernel; no attention-weight
+        output).
+      causal: enforce causality; ANDed with any provided ``mask``.
       cache: optional decode KV cache ``{"k","v","index"}`` with k/v shaped
         (B, max_len, H, D); when given, S_q is the number of new positions
         (1 for greedy decode), new k/v are written at ``index`` and attention
@@ -171,8 +171,14 @@ def mha_apply(
         mask = cmask if mask is None else jnp.logical_and(mask, cmask)
 
     if impl == "flash" and cache is None:
-        from transformer_tpu.kernels.flash_attention import flash_attention
-
+        try:
+            from transformer_tpu.kernels.flash_attention import flash_attention
+        except ImportError as e:  # pragma: no cover
+            raise NotImplementedError(
+                "attention_impl='flash' requires transformer_tpu.kernels."
+                "flash_attention (Pallas kernel) which is not available: "
+                f"{e}"
+            ) from e
         out = flash_attention(
             q, k, v, mask=mask,
             block_q=flash_block_q,

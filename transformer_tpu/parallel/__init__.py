@@ -1,0 +1,36 @@
+"""Distributed engine (L6): device meshes, sharding rules, sharded train
+steps, and sequence-parallel ring attention.
+
+The TPU-native replacement for the reference's
+``tf.distribute.MirroredStrategy``/NCCL layer (``distributed_train.py``):
+instead of a strategy object fanning a step out to replicas with hidden
+all-reduces, a ``jax.sharding.Mesh`` plus PartitionSpecs on state and batch
+turn the *same* train step into an SPMD program — XLA inserts the gradient
+psum (over ICI within a slice, DCN across slices) where the shardings demand
+it. No launcher daemon, no per-replica iterators, no explicit collectives in
+user code.
+"""
+
+from transformer_tpu.parallel.mesh import make_mesh
+from transformer_tpu.parallel.sharding import (
+    batch_spec,
+    param_partition_spec,
+    state_shardings,
+)
+from transformer_tpu.parallel.distributed import (
+    DistributedTrainer,
+    create_sharded_state,
+    make_sharded_steps,
+    put_batch,
+)
+
+__all__ = [
+    "DistributedTrainer",
+    "batch_spec",
+    "create_sharded_state",
+    "make_mesh",
+    "make_sharded_steps",
+    "param_partition_spec",
+    "put_batch",
+    "state_shardings",
+]

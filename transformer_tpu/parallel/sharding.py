@@ -1,0 +1,115 @@
+"""Partition rules: parameter-path regex -> PartitionSpec.
+
+The t5x-style approach, matched to this framework's parameter tree layout
+(``ops/attention.py:mha_init``, ``ops/ffn.py:ffn_init``, ``ops/nn.py``):
+
+==========================================  =============================
+path suffix                                  spec (dims of the array)
+==========================================  =============================
+embedding/table          (V, M)              ('fsdp', None)
+query|key|value/kernel   (M, H, D)           ('fsdp', 'model', None)
+query|key|value/bias     (H, D)              ('model', None)
+out/kernel               (H, D, M)           ('model', None, 'fsdp')
+ffn in/kernel            (M, F)              ('fsdp', 'model')
+ffn in/bias              (F,)                ('model',)
+ffn out/kernel           (F, M)              ('model', 'fsdp')
+final/kernel             (M, V)              ('fsdp', 'model')
+final/bias               (V,)                ('model',)
+layernorm scale/bias                          replicated
+==========================================  =============================
+
+Attention is head-sharded and the FFN column/row-sharded on 'model' (tensor
+parallelism: the pair of matmuls per block needs exactly one psum, which XLA
+inserts). 'fsdp' shards the remaining large dimension zero-style. Any
+dimension that doesn't divide its mesh axis falls back to replicated — a
+static check, not a runtime surprise.
+
+Optimizer state (Adam mu/nu) mirrors the parameter tree inside the optax
+state pytree, so the same path-suffix rules apply wherever a parameter path
+appears; scalars (step, count) replicate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path-suffix regex, spec builder). First match wins.
+_RULES: list[tuple[str, P]] = [
+    (r"embedding/table$", P("fsdp", None)),
+    (r"(query|key|value)/kernel$", P("fsdp", "model", None)),
+    (r"(query|key|value)/bias$", P("model", None)),
+    (r"out/kernel$", P("model", None, "fsdp")),
+    (r"out/bias$", P(None)),
+    (r"ffn/in/kernel$", P("fsdp", "model")),
+    (r"ffn/in/bias$", P("model")),
+    (r"ffn/out/kernel$", P("model", "fsdp")),
+    (r"ffn/out/bias$", P(None)),
+    (r"final/kernel$", P("fsdp", "model")),
+    (r"final/bias$", P("model")),
+    (r"(ln1|ln2|ln_ffn|final_ln)/(scale|bias)$", P(None)),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _divisible(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Drop sharding on any dim that doesn't divide its mesh axis (or when the
+    spec has more dims than the array — scalars in odd spots)."""
+    if len(spec) > len(shape):
+        return P()
+    out = []
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            out.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        out.append(axis if shape[dim] % size == 0 else None)
+    return P(*out)
+
+
+def param_partition_spec(path, leaf, mesh: Mesh) -> P:
+    """Spec for one leaf given its tree path (works for params and for optax
+    state, whose leaves carry the same path suffixes)."""
+    s = _path_str(path)
+    shape = getattr(leaf, "shape", ())
+    if not shape:
+        return P()
+    for pattern, spec in _RULES:
+        if re.search(pattern, s):
+            return _divisible(spec, shape, mesh)
+    return P()
+
+
+def state_shardings(state_shape: Any, mesh: Mesh) -> Any:
+    """NamedShardings for a TrainState (or any pytree) from its eval_shape."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, param_partition_spec(path, leaf, mesh)),
+        state_shape,
+    )
+
+
+def batch_spec(mesh: Mesh, shard_seq: bool = False) -> P:
+    """(B, S) token batches shard over batch on data×fsdp (fsdp is data
+    parallelism with parameter sharding on top) and optionally over sequence
+    on 'seq' (ring attention)."""
+    del mesh
+    return P(("data", "fsdp"), "seq" if shard_seq else None)

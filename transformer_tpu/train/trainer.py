@@ -98,21 +98,44 @@ def make_eval_step(
 
 
 class MetricAccumulator:
-    """Exact host-side accumulation of device-computed sums — replacement for
-    the reference's Keras streaming metrics (``train.py:70-73,181-184``)."""
+    """Exact accumulation of device-computed sums — replacement for the
+    reference's Keras streaming metrics (``train.py:70-73,181-184``).
+
+    Sums are kept as (device) arrays and added lazily, so updating metrics
+    every step does NOT force a host-device sync — reading ``.loss`` /
+    ``.accuracy`` (at log boundaries) is the only blocking point. This
+    preserves JAX async dispatch: step N+1 enqueues while N runs.
+    """
+
+    _KEYS = ("loss_sum", "weight", "correct")
 
     def __init__(self) -> None:
         self.reset()
 
     def reset(self) -> None:
-        self.loss_sum = 0.0
-        self.weight = 0.0
-        self.correct = 0.0
+        self._sums: dict[str, Any] | None = None
 
     def update(self, metrics: dict[str, Any]) -> None:
-        self.loss_sum += float(metrics["loss_sum"])
-        self.weight += float(metrics["weight"])
-        self.correct += float(metrics["correct"])
+        part = {k: metrics[k] for k in self._KEYS}
+        if self._sums is None:
+            self._sums = part
+        else:
+            self._sums = {k: self._sums[k] + part[k] for k in self._KEYS}
+
+    def _get(self, key: str) -> float:
+        return 0.0 if self._sums is None else float(self._sums[key])
+
+    @property
+    def loss_sum(self) -> float:
+        return self._get("loss_sum")
+
+    @property
+    def weight(self) -> float:
+        return self._get("weight")
+
+    @property
+    def correct(self) -> float:
+        return self._get("correct")
 
     @property
     def loss(self) -> float:
@@ -170,7 +193,7 @@ class Trainer:
         for i, (src, tgt) in enumerate(batches):
             if max_batches is not None and i >= max_batches:
                 break
-            m = self.eval_step(self.state, jnp.asarray(src), jnp.asarray(tgt))
+            m = self.eval_step(self.state, src, tgt)
             self.eval_metrics.update(m)
 
     def fit(self, train_ds, test_ds=None, rng: jax.Array | None = None) -> None:
@@ -183,15 +206,16 @@ class Trainer:
                 self.state = restored
                 self.log_fn(f"restored checkpoint at step {int(self.state.step)}")
 
+        # Host-side step mirror: consulting state.step (a device array) every
+        # iteration would block async dispatch.
+        step = int(self.state.step)
         for epoch in range(cfg.epochs):
             self.train_metrics.reset()
             epoch_start = time.time()
             for src, tgt in train_ds.batches(epoch):
-                self.state, m = self.train_step(
-                    self.state, jnp.asarray(src), jnp.asarray(tgt), rng
-                )
+                self.state, m = self.train_step(self.state, src, tgt, rng)
                 self.train_metrics.update(m)
-                step = int(self.state.step)
+                step += 1
                 if cfg.log_every_steps and step % cfg.log_every_steps == 0:
                     self.log_fn(
                         f"epoch {epoch + 1} step {step} "
