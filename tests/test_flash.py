@@ -1,0 +1,193 @@
+"""Pallas flash-attention kernel vs the XLA oracle.
+
+Runs in Pallas interpret mode on the CPU test platform (conftest), so the
+kernel logic — online softmax, block masking, custom VJP — is checked exactly,
+not modulo MXU rounding. The oracle is ``ops.attention.dot_product_attention``,
+itself validated against NumPy in test_ops.py.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.kernels.flash_attention import flash_attention
+from transformer_tpu.models import transformer_apply, transformer_init
+from transformer_tpu.ops.attention import dot_product_attention
+
+
+def _qkv(rng, b=2, s=64, h=2, d=32, dtype=jnp.float32):
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+class TestForward:
+    def test_no_mask(self, rng):
+        q, k, v = _qkv(rng)
+        got = flash_attention(q, k, v, block_q=32, block_k=32)
+        want, _ = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+    def test_causal(self, rng):
+        q, k, v = _qkv(rng)
+        got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        mask = jnp.tril(jnp.ones((64, 64), bool))[None, None]
+        want, _ = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+    def test_padding_and_causal(self, rng):
+        q, k, v = _qkv(rng)
+        kv_mask = jnp.asarray(rng.integers(0, 2, (2, 64)), bool).at[:, :4].set(True)
+        got = flash_attention(q, k, v, kv_mask=kv_mask, causal=True, block_q=32, block_k=32)
+        mask = jnp.logical_and(
+            jnp.tril(jnp.ones((64, 64), bool))[None, None],
+            kv_mask[:, None, None, :],
+        )
+        want, _ = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+    def test_fully_masked_rows_are_finite(self, rng):
+        """A row whose keys are all padding must not produce NaN (the
+        exp(MASKED-MASKED)=1 pitfall of online softmax)."""
+        q, k, v = _qkv(rng, s=32)
+        kv_mask = jnp.zeros((2, 32), bool)  # everything padded
+        got = flash_attention(q, k, v, kv_mask=kv_mask, block_q=16, block_k=16)
+        assert bool(jnp.isfinite(got).all())
+
+    def test_cross_attention_lengths(self, rng):
+        """S_q != S_k (decoder cross-attention shape)."""
+        q = jnp.asarray(rng.normal(size=(2, 16, 2, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, 64, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 64, 2, 32)), jnp.float32)
+        kv_mask = jnp.asarray(rng.integers(0, 2, (2, 64)), bool).at[:, 0].set(True)
+        got = flash_attention(q, k, v, kv_mask=kv_mask, block_q=16, block_k=32)
+        want, _ = dot_product_attention(q, k, v, kv_mask[:, None, None, :])
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+    def test_non_divisible_block_clamps(self, rng):
+        """Requested block larger than / not dividing S falls back to a divisor."""
+        q, k, v = _qkv(rng, s=48)
+        got = flash_attention(q, k, v, block_q=128, block_k=128)
+        want, _ = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+    def test_bfloat16(self, rng):
+        q, k, v = _qkv(rng, dtype=jnp.bfloat16)
+        got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        assert got.dtype == jnp.bfloat16
+        mask = jnp.tril(jnp.ones((64, 64), bool))[None, None]
+        want, _ = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(
+            got.astype(jnp.float32), want.astype(jnp.float32), atol=2e-2
+        )
+
+    def test_jit_compatible(self, rng):
+        q, k, v = _qkv(rng, s=32)
+        fn = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        )
+        want, _ = dot_product_attention(
+            q, k, v, jnp.tril(jnp.ones((32, 32), bool))[None, None]
+        )
+        np.testing.assert_allclose(fn(q, k, v), want, atol=2e-6)
+
+
+class TestBackward:
+    def test_grads_match_xla(self, rng):
+        q, k, v = _qkv(rng)
+        kv_mask = jnp.asarray(rng.integers(0, 2, (2, 64)), bool).at[:, :4].set(True)
+        mask = jnp.logical_and(
+            jnp.tril(jnp.ones((64, 64), bool))[None, None],
+            kv_mask[:, None, None, :],
+        )
+
+        def f_flash(q, k, v):
+            out = flash_attention(q, k, v, kv_mask=kv_mask, causal=True, block_q=32, block_k=32)
+            return (out**2).sum()
+
+        def f_xla(q, k, v):
+            out, _ = dot_product_attention(q, k, v, mask)
+            return (out**2).sum()
+
+        got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, atol=5e-5)
+
+    def test_grads_no_mask(self, rng):
+        q, k, v = _qkv(rng, s=32)
+
+        def f_flash(q, k, v):
+            return flash_attention(q, k, v, block_q=16, block_k=16).sum()
+
+        def f_xla(q, k, v):
+            return dot_product_attention(q, k, v)[0].sum()
+
+        got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, atol=5e-5)
+
+
+class TestModelIntegration:
+    """attention_impl='flash' must be a drop-in swap for 'xla'."""
+
+    def _cfgs(self):
+        cfg_xla = ModelConfig(
+            num_layers=2, d_model=32, num_heads=2, dff=64,
+            input_vocab_size=40, target_vocab_size=40, max_position=32,
+            dtype="float32", dropout_rate=0.0,
+        )
+        cfg_flash = dataclasses.replace(
+            cfg_xla, attention_impl="flash", flash_block_q=8, flash_block_k=8
+        )
+        return cfg_xla, cfg_flash
+
+    def _batch(self, rng):
+        src = jnp.asarray(rng.integers(1, 40, (4, 16)), jnp.int32).at[:, 12:].set(0)
+        tgt = jnp.asarray(rng.integers(1, 40, (4, 16)), jnp.int32).at[:, 10:].set(0)
+        return src, tgt
+
+    def test_seq2seq_forward_parity(self, rng):
+        cfg_xla, cfg_flash = self._cfgs()
+        params = transformer_init(jax.random.PRNGKey(0), cfg_xla)
+        src, tgt = self._batch(rng)
+        want, _ = transformer_apply(params, src, tgt, cfg_xla)
+        got, _ = transformer_apply(params, src, tgt, cfg_flash)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_seq2seq_grad_parity(self, rng):
+        cfg_xla, cfg_flash = self._cfgs()
+        params = transformer_init(jax.random.PRNGKey(0), cfg_xla)
+        src, tgt = self._batch(rng)
+
+        def loss(p, cfg):
+            logits, _ = transformer_apply(p, src, tgt, cfg)
+            logp = jax.nn.log_softmax(logits)
+            msk = tgt != 0
+            nll = -jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+            return (nll * msk).sum() / msk.sum()
+
+        g_xla = jax.grad(loss)(params, cfg_xla)
+        g_flash = jax.grad(loss)(params, cfg_flash)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g_xla, g_flash
+        )
+
+    def test_decoder_only_parity(self, rng):
+        cfg_xla, cfg_flash = self._cfgs()
+        cfg_xla = dataclasses.replace(cfg_xla, decoder_only=True)
+        cfg_flash = dataclasses.replace(cfg_flash, decoder_only=True)
+        params = transformer_init(jax.random.PRNGKey(1), cfg_xla)
+        _, tgt = self._batch(rng)
+        want, _ = transformer_apply(params, None, tgt, cfg_xla)
+        got, _ = transformer_apply(params, None, tgt, cfg_flash)
+        np.testing.assert_allclose(got, want, atol=1e-5)

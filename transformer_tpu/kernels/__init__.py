@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The one genuinely hot kernel in the reference is scaled dot-product attention
+(``Attention.py:20-32``, invoked 3×num_layers times per step); its blockwise
+TPU-native replacement lives here. Everything else (layernorm, FFN, masking)
+fuses well under plain XLA and deliberately stays out of Pallas.
+"""
+
+from transformer_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

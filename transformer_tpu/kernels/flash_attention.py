@@ -1,0 +1,470 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel, forward + backward.
+
+This is the TPU-native replacement for the reference's
+``scaled_dot_product_attention`` (``Attention.py:3-34``) at long sequence
+length: instead of materializing the full (B, H, S, S) score tensor in HBM
+(reference ``Attention.py:20``), scores are computed tile-by-tile in VMEM with
+an online softmax, so memory is O(S·D) and the two matmuls per tile stay on
+the MXU. The (B·H, q-block, k-block) grid walks the k-axis sequentially,
+carrying the running max / normalizer / output accumulator in VMEM scratch —
+the canonical TPU flash-attention schedule.
+
+Semantics match ``ops.attention.dot_product_attention``:
+
+- softmax in fp32 regardless of input dtype;
+- optional key-padding mask (True = "may attend"), same polarity as
+  ``ops.masks``;
+- optional causal masking, passed *structurally* (a static flag, not a dense
+  (S, S) mask) so fully-above-diagonal tiles are skipped outright.
+
+The backward pass is the standard two-kernel split: one accumulates dQ over
+k-blocks, the other dK/dV over q-blocks, both recomputing the tile of
+attention probabilities from the saved per-row logsumexp rather than storing
+the (S, S) probability matrix.
+
+On non-TPU backends the kernels run in Pallas interpret mode, which is how the
+CPU test suite exercises them bit-for-bit against the XLA oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+# Finite stand-in for -inf: keeps fully-masked rows NaN-free (same approach as
+# the reference's additive -1e9, ``Attention.py:26``) while staying far below
+# any reachable logit so the exp-guard below can recognize masked entries.
+_MASKED = -1e30
+_MASK_GUARD = -1e29
+
+
+@dataclasses.dataclass(frozen=True)
+class _FlashConfig:
+    """Static kernel configuration (hashable: used as a nondiff custom-vjp arg)."""
+
+    causal: bool
+    has_mask: bool
+    block_q: int
+    block_k: int
+    num_heads: int  # for the kv-mask index map: grid axis 0 runs over B*H
+    scale: float
+    interpret: bool
+
+
+def _largest_divisor_block(seq_len: int, requested: int) -> int:
+    block = min(requested, seq_len)
+    while seq_len % block:
+        block -= 1
+    return block
+
+
+def _compiler_params(dimension_semantics: tuple[str, ...]):
+    try:
+        return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
+    except TypeError:  # pragma: no cover - older/newer field spellings
+        return None
+
+
+def _visible(cfg: _FlashConfig, i, j):
+    """Whether k-block j has any position visible to q-block i under causality."""
+    return j * cfg.block_k <= i * cfg.block_q + cfg.block_q - 1
+
+
+def _tile_bias(cfg: _FlashConfig, s, i, j, mask_ref):
+    """Apply key-padding and intra-tile causal masking to a (bq, bk) score tile."""
+    if cfg.has_mask:
+        # Mask arrives pre-tiled as (B, nk, 1, block_k) so each grid step maps
+        # its (1, block_k) tile as a full block — TPU lane tiling forbids a
+        # blocked lane dim that is neither 128-aligned nor the whole array.
+        valid = mask_ref[0, 0] != 0  # (1, block_k)
+        s = jnp.where(valid, s, _MASKED)
+    if cfg.causal:
+        rows = i * cfg.block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (cfg.block_q, cfg.block_k), 0
+        )
+        cols = j * cfg.block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (cfg.block_q, cfg.block_k), 1
+        )
+        s = jnp.where(cols <= rows, s, _MASKED)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(cfg: _FlashConfig, *refs):
+    if cfg.has_mask:
+        mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        mask_ref = None
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _MASKED)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * cfg.scale  # (bq, D)
+        k = k_ref[0].astype(jnp.float32)  # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        s = _tile_bias(cfg, s, i, j, mask_ref)
+
+        m_prev = m_scr[:, 0:1]  # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # exp(_MASKED - _MASKED) would be 1, silently attending to masked
+        # positions in all-masked tiles — zero those entries explicitly.
+        p = jnp.where(s > _MASK_GUARD, jnp.exp(s - m_new), 0.0)  # (bq, bk)
+        correction = jnp.exp(m_prev - m_new)  # (bq, 1)
+        l_new = correction * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)  # (bk, D)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if cfg.causal:
+        pl.when(_visible(cfg, i, j))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, 0:1] + jnp.log(l_safe)
+
+
+def _fwd(cfg: _FlashConfig, q, k, v, kv_mask):
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    nq = s_q // cfg.block_q
+    nk = s_k // cfg.block_k
+
+    in_specs = []
+    inputs = []
+    if cfg.has_mask:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, 1, cfg.block_k), lambda b, i, j: (b // cfg.num_heads, j, 0, 0)
+            )
+        )
+        inputs.append(kv_mask)
+    in_specs += [
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs += [q, k, v]
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, i, j: (b, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            # Per-row logsumexp, stored column-shaped (bq, 1) per tile so the
+            # backward pass broadcasts it along lanes with no relayout.
+            jax.ShapeDtypeStruct((bh, nq, cfg.block_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_q, 128), jnp.float32),
+            pltpu.VMEM((cfg.block_q, 128), jnp.float32),
+            pltpu.VMEM((cfg.block_q, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(*inputs)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(cfg: _FlashConfig, q_ref, k_ref, lse_ref, mask_ref, i, j):
+    """Recompute the (bq, bk) probability tile from the saved logsumexp."""
+    q = q_ref[0].astype(jnp.float32) * cfg.scale
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = _tile_bias(cfg, s, i, j, mask_ref)
+    lse = lse_ref[0, 0]  # (bq, 1) column — broadcasts along lanes
+    p = jnp.where(s > _MASK_GUARD, jnp.exp(s - lse), 0.0)
+    return q, k, p
+
+
+def _dq_kernel(cfg: _FlashConfig, *refs):
+    if cfg.has_mask:
+        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        mask_ref = None
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        _, k, p = _recompute_p(cfg, q_ref, k_ref, lse_ref, mask_ref, i, j)
+        do = do_ref[0].astype(jnp.float32)  # (bq, D)
+        v = v_ref[0].astype(jnp.float32)  # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0])  # delta: (bq, 1) column
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if cfg.causal:
+        pl.when(_visible(cfg, i, j))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        # s = (q·scale)·kᵀ, so dq picks up one more factor of scale.
+        dq_ref[0] = (dq_scr[:] * cfg.scale).astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(cfg: _FlashConfig, *refs):
+    if cfg.has_mask:
+        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        mask_ref = None
+    j = pl.program_id(1)  # k-block: parallel axis
+    i = pl.program_id(2)  # q-block: sequential accumulation axis
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q_scaled, _, p = _recompute_p(cfg, q_ref, k_ref, lse_ref, mask_ref, i, j)
+        do = do_ref[0].astype(jnp.float32)  # (bq, D)
+        v = v_ref[0].astype(jnp.float32)  # (bk, D)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # pᵀ·do -> (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0])
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_scaled, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dsᵀ·(q·scale) -> (bk, D)
+
+    if cfg.causal:
+        pl.when(_visible(cfg, i, j))(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(cfg: _FlashConfig, q, k, v, kv_mask, out, lse, do):
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    nq = s_q // cfg.block_q
+    nk = s_k // cfg.block_k
+
+    # Per-row rowsum(do * out) — tiny elementwise op, left to XLA to fuse.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(bh, nq, cfg.block_q, 1)
+
+    q_spec_i = lambda b, i, j: (b, i, 0)  # noqa: E731
+    lse_spec_i = lambda b, i, j: (b, i, 0, 0)  # noqa: E731
+
+    in_specs = []
+    inputs = []
+    if cfg.has_mask:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, 1, cfg.block_k), lambda b, i, j: (b // cfg.num_heads, j, 0, 0)
+            )
+        )
+        inputs.append(kv_mask)
+    in_specs += [
+        pl.BlockSpec((1, cfg.block_q, d), q_spec_i),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_q, d), q_spec_i),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lse_spec_i),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lse_spec_i),
+    ]
+    inputs += [q, k, v, do, lse, delta]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, cfg.block_q, d), q_spec_i),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(*inputs)
+
+    # dk/dv: k-blocks parallel, q-blocks sequential.
+    in_specs_kv = []
+    inputs_kv = []
+    if cfg.has_mask:
+        in_specs_kv.append(
+            pl.BlockSpec(
+                (1, 1, 1, cfg.block_k), lambda b, j, i: (b // cfg.num_heads, j, 0, 0)
+            )
+        )
+        inputs_kv.append(kv_mask)
+    in_specs_kv += [
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, j, i: (b, i, 0, 0)),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, j, i: (b, i, 0, 0)),
+    ]
+    inputs_kv += [q, k, v, do, lse, delta]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, cfg),
+        grid=(bh, nk, nq),
+        in_specs=in_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(*inputs_kv)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public entry point
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _FlashConfig, q, k, v, kv_mask):
+    out, _ = _fwd(cfg, q, k, v, kv_mask)
+    return out
+
+
+def _flash_fwd_rule(cfg, q, k, v, kv_mask):
+    out, lse = _fwd(cfg, q, k, v, kv_mask)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _flash_bwd_rule(cfg, residuals, do):
+    q, k, v, kv_mask, out, lse = residuals
+    dq, dk, dv = _bwd(cfg, q, k, v, kv_mask, out, lse, do)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    kv_mask: jax.Array | None = None,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Blockwise attention over (B, S, H, D) activations.
+
+    Args:
+      q, k, v: (B, S_q|S_k, H, D). Cross-attention (S_q != S_k) is supported.
+      kv_mask: optional (B, S_k) bool/int, True where the key is a real token
+        (the padding mask of ``ops.masks.make_padding_mask`` squeezed to 2D).
+      causal: structural causal masking (requires S_q == S_k positions to be
+        aligned, as in self-attention).
+      block_q, block_k: tile sizes; shrunk to the largest divisor of the
+        sequence length at or below the request.
+      interpret: run in Pallas interpret mode. Default: True off-TPU, so the
+        same code path is testable on CPU.
+
+    Returns the (B, S_q, H, D) attention output in q's dtype.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, S, H, D) inputs, got shape {q.shape}")
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if causal and s_q != s_k:
+        raise ValueError("causal flash attention requires S_q == S_k")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    cfg = _FlashConfig(
+        causal=causal,
+        has_mask=kv_mask is not None,
+        block_q=_largest_divisor_block(s_q, block_q),
+        block_k=_largest_divisor_block(s_k, block_k),
+        num_heads=h,
+        scale=d**-0.5,
+        interpret=bool(interpret),
+    )
+
+    # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows.
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    # Pre-tile the mask to (B, nk, 1, block_k): each (1, block_k) tile is a
+    # full block under the TPU lane-tiling rules.
+    mask_i32 = (
+        None
+        if kv_mask is None
+        else jnp.broadcast_to(kv_mask, (b, s_k))
+        .astype(jnp.int32)
+        .reshape(b, s_k // cfg.block_k, 1, cfg.block_k)
+    )
+    out = _flash(cfg, fold(q), fold(k), fold(v), mask_i32)
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)

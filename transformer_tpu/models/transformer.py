@@ -21,7 +21,7 @@ import jax
 from transformer_tpu.config import PAD_ID, ModelConfig
 from transformer_tpu.models.decoder import decoder_apply, decoder_init
 from transformer_tpu.models.encoder import encoder_apply, encoder_init
-from transformer_tpu.ops.masks import make_padding_mask, make_seq2seq_masks
+from transformer_tpu.ops.masks import make_padding_mask
 from transformer_tpu.ops.nn import Params, dense_apply, dense_init, embedding_attend
 
 
@@ -78,13 +78,22 @@ def transformer_apply(
         )
         return _logits(params, x, cfg), attn
 
-    enc_mask, combined_mask, cross_mask = make_seq2seq_masks(inp, tar, pad_id)
+    # Encoder self-attention and decoder cross-attention both mask source
+    # padding; decoder self-attention masks target padding, with causality
+    # applied structurally inside MHA (``causal=True`` in decoder_layer_apply)
+    # so the flash/ring kernels can skip above-diagonal blocks. Together these
+    # equal the reference's three ``create_masks`` outputs
+    # (``positionalencoding.py:37-52``) — see ``ops.masks.make_seq2seq_masks``
+    # for the dense-mask form.
+    enc_mask = make_padding_mask(inp, pad_id)
+    cross_mask = enc_mask
+    self_mask = make_padding_mask(tar, pad_id)
     r_enc, r_dec = (None, None) if rng is None else jax.random.split(rng)
     enc_out, enc_attn = encoder_apply(
         params["encoder"], inp, enc_mask, cfg, r_enc, deterministic, return_weights
     )
     x, dec_attn, _ = decoder_apply(
-        params["decoder"], tar, enc_out, combined_mask, cross_mask, cfg,
+        params["decoder"], tar, enc_out, self_mask, cross_mask, cfg,
         r_dec, deterministic, return_weights,
     )
     return _logits(params, x, cfg), {**enc_attn, **dec_attn}

@@ -163,34 +163,45 @@ def mha_apply(
         mask = valid if mask is None else jnp.logical_and(mask, valid)
         k = k.astype(dtype)
         v = v.astype(dtype)
-    elif causal:
-        # Causality is enforced whether or not a padding mask was provided.
-        from transformer_tpu.ops.masks import make_causal_mask
-
-        cmask = make_causal_mask(x_q.shape[1])
-        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
 
     if impl == "flash" and cache is None:
-        try:
-            from transformer_tpu.kernels.flash_attention import flash_attention
-        except ImportError as e:  # pragma: no cover
-            raise NotImplementedError(
-                "attention_impl='flash' requires transformer_tpu.kernels."
-                "flash_attention (Pallas kernel) which is not available: "
-                f"{e}"
-            ) from e
+        # Causality stays structural (a static kernel flag) so the Pallas
+        # kernel can skip above-diagonal tiles instead of masking them.
+        from transformer_tpu.kernels.flash_attention import flash_attention
+
+        if mask is None:
+            kv_mask = None
+        elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[-2] == 1:
+            kv_mask = mask[:, 0, 0, :]  # (B|1, 1, 1, S_k) -> (B|1, S_k)
+        else:
+            raise ValueError(
+                "attention_impl='flash' takes a key-padding mask "
+                "(B, 1, 1, S_k) plus the structural causal flag; got a mask "
+                f"of shape {mask.shape}. Per-head masks are unsupported, and "
+                "causality must be passed as causal=True, not folded into "
+                "the mask."
+            )
         out = flash_attention(
-            q, k, v, mask=mask,
+            q, k, v,
+            kv_mask=kv_mask,
+            causal=causal,
             block_q=flash_block_q,
             block_k=flash_block_k,
         )
         weights = None
     elif impl == "ring" and cache is None:
         raise NotImplementedError(
-            "attention_impl='ring' is a stack-level sequence-parallel transform; "
-            "use transformer_tpu.parallel.ring_attention inside shard_map"
+            "attention_impl='ring' is a stack-level sequence-parallel "
+            "transform; use transformer_tpu.parallel.ring_attention "
+            "inside shard_map (see parallel.make_sequence_parallel_attention)"
         )
     else:
+        if causal and cache is None:
+            # Causality is enforced whether or not a padding mask was provided.
+            from transformer_tpu.ops.masks import make_causal_mask
+
+            cmask = make_causal_mask(x_q.shape[1])
+            mask = cmask if mask is None else jnp.logical_and(mask, cmask)
         out, weights = dot_product_attention(q, k, v, mask, return_weights=return_weights)
 
     merged = jnp.einsum(

@@ -52,18 +52,27 @@ def initialize_distributed(
 ) -> None:
     """Multi-host bring-up. On TPU pods the runtime provides everything and a
     bare ``jax.distributed.initialize()`` suffices; explicit args support
-    CPU/GPU fleets. Safe to call when single-process (no-op on failure to
-    detect a cluster)."""
-    if jax.process_count() > 1:
-        return  # already initialized
-    try:
-        if coordinator_address is not None:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
-        elif num_processes is not None:
+    CPU/GPU fleets.
+
+    Must run before any JAX call that initializes the XLA backend (including
+    ``jax.process_count()``/``jax.devices()``) — ``jax.distributed.initialize``
+    raises otherwise, so this function probes initialization state without
+    touching the backend and re-raises real bring-up failures instead of
+    silently degrading to a single-host run."""
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized (e.g. by the launcher)
+    if coordinator_address is None and num_processes is None and process_id is None:
+        # Auto-detection: only meaningful where a cluster environment exists
+        # (TPU pod metadata, SLURM, ...). Absent one, stay single-process.
+        try:
             jax.distributed.initialize()
-    except Exception:  # single-process run: nothing to join
-        pass
+        except Exception:
+            return
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

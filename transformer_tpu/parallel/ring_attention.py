@@ -1,0 +1,197 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no long-context story at all — sequence length is capped at
+50 and the full (B, H, S, S) score tensor is materialized per step
+(``Attention.py:20``, ``utils.py:22``; SURVEY.md §5 "Long-context"). These are
+the TPU-native mechanisms that make the 4096-token decoder-only config
+(BASELINE.json configs[4]) scale past one chip:
+
+- **Ring attention** (``ring_attention``): activations are sharded along the
+  sequence on the ``seq`` mesh axis. Each device scores its local query chunk
+  against every key/value chunk as the chunks rotate around the ring via
+  ``lax.ppermute`` over ICI, folding each contribution in with the same
+  online-softmax update the flash kernel uses. Peak memory is O(S/P · S/P)
+  per device and the permute overlaps with the matmuls under XLA's latency
+  hiding scheduler.
+
+- **Ulysses** (``ulysses_attention``): two ``lax.all_to_all``s re-shard the
+  activation from sequence-sharded to head-sharded and back, so each device
+  runs *full-sequence* attention on H/P heads. Cheaper collectives for
+  moderate S (2 all-to-alls vs P-1 permutes of the whole KV), but requires
+  num_heads % P == 0 and the full S on every chip.
+
+Both are **per-shard** functions: call them inside ``shard_map`` (or any
+context where ``axis_name`` is bound). ``make_sequence_parallel_attention``
+wraps either in shard_map against a concrete mesh for stack-level use.
+
+Mask/causality semantics mirror ``kernels.flash_attention``: an optional
+(B, S_local) key-padding mask (True = attend) plus a structural causal flag;
+chunk-level causality is resolved from ring positions, so above-diagonal
+chunk pairs contribute nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_MASKED = -1e30
+_MASK_GUARD = -1e29
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    kv_mask: jax.Array | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise ring attention over a sequence-sharded activation.
+
+    Args:
+      q, k, v: (B, C, H, D) local chunks, C = S / axis_size. Chunk i on
+        device i covers global positions [i*C, (i+1)*C).
+      axis_name: mesh axis the sequence is sharded over (bound in shard_map).
+      axis_size: number of devices on that axis (static Python int — the ring
+        is unrolled so XLA can overlap each ppermute with the next matmul).
+      kv_mask: optional (B, C) bool, True where the local key is real.
+      causal: structural causal masking across global positions.
+
+    Returns (B, C, H, D) in q's dtype.
+    """
+    b, c, h, d = q.shape
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = d**-0.5
+    qf = q.astype(jnp.float32).transpose(0, 2, 1, 3) * scale  # (B, H, C, D)
+
+    m = jnp.full((b, h, c, 1), _MASKED, jnp.float32)
+    l = jnp.zeros((b, h, c, 1), jnp.float32)
+    acc = jnp.zeros((b, h, c, d), jnp.float32)
+
+    shift = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    k_cur, v_cur = k, v
+    mask_cur = kv_mask
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+
+    for t in range(axis_size):
+        src = (my_idx - t) % axis_size  # which global chunk we hold this step
+        kf = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B, H, C, D)
+        vf = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)  # (B, H, C, C)
+        if mask_cur is not None:
+            s = jnp.where(mask_cur[:, None, None, :], s, _MASKED)
+        if causal:
+            # Global row = my_idx*C + r, global col = src*C + c: the whole
+            # chunk pair is below (src < my), on (src == my), or above the
+            # diagonal — where() keeps it branch-free and XLA-friendly.
+            visible = (src * c + cols) <= (my_idx * c + rows)
+            s = jnp.where(visible[None, None], s, _MASKED)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > _MASK_GUARD, jnp.exp(s - m_new), 0.0)
+        correction = jnp.exp(m - m_new)
+        l = correction * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        m = m_new
+        if t + 1 < axis_size:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, shift)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, shift)
+            if mask_cur is not None:
+                mask_cur = jax.lax.ppermute(mask_cur, axis_name, shift)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).transpose(0, 2, 1, 3)  # (B, C, H, D)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    kv_mask: jax.Array | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Ulysses-style sequence parallelism: all-to-all from sequence-sharded
+    (B, C, H, D) to head-sharded (B, S, H/P, D), full-sequence attention per
+    device, and all-to-all back. Requires H % axis_size == 0."""
+    b, c, h, d = q.shape
+    if h % axis_size:
+        raise ValueError(
+            f"ulysses needs num_heads ({h}) divisible by the seq axis ({axis_size})"
+        )
+
+    def seq_to_heads(x):  # (B, C, H, D) -> (B, S, H/P, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # (B, S, H/P, D) -> (B, C, H, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    q_full, k_full, v_full = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    mask = None
+    if kv_mask is not None:
+        full_kv = jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)  # (B, S)
+        mask = full_kv[:, None, None, :]
+    if causal:
+        s_full = q_full.shape[1]
+        cmask = jnp.tril(jnp.ones((s_full, s_full), dtype=jnp.bool_))[None, None]
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+
+    from transformer_tpu.ops.attention import dot_product_attention
+
+    out, _ = dot_product_attention(q_full, k_full, v_full, mask)
+    return heads_to_seq(out)
+
+
+def make_sequence_parallel_attention(
+    mesh: Mesh,
+    impl: str = "ring",
+    axis: str = "seq",
+    batch_axes: tuple[str, ...] = (),
+):
+    """Wrap ring/ulysses attention in shard_map against a concrete mesh.
+
+    Returns ``fn(q, k, v, kv_mask=None, causal=False)`` over *global*
+    (B, S, H, D) arrays with S sharded on ``axis`` (and optionally B on
+    ``batch_axes``) — the stack-level entry point used by the long-context
+    trunk and the parity tests.
+    """
+    axis_size = mesh.shape[axis]
+    inner = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    bdim = tuple(batch_axes) if batch_axes else None
+    act = P(bdim, axis, None, None)
+    mask_spec = P(bdim, axis)
+
+    def call(q, k, v, kv_mask=None, causal=False):
+        fn = functools.partial(
+            inner, axis_name=axis, axis_size=axis_size, causal=causal
+        )
+        if kv_mask is None:
+            sharded = jax.shard_map(
+                lambda q, k, v: fn(q, k, v),
+                mesh=mesh,
+                in_specs=(act, act, act),
+                out_specs=act,
+                check_vma=False,
+            )
+            return sharded(q, k, v)
+        sharded = jax.shard_map(
+            lambda q, k, v, m: fn(q, k, v, kv_mask=m),
+            mesh=mesh,
+            in_specs=(act, act, act, mask_spec),
+            out_specs=act,
+            check_vma=False,
+        )
+        return sharded(q, k, v, kv_mask)
+
+    return call
