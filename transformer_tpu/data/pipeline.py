@@ -152,7 +152,8 @@ class Seq2SeqDataset:
         for row, i in enumerate(idx):
             if i < 0:
                 continue  # padding row
-            s, t = self.src[i], self.tgt[i]
+            s = self.src[i][: self.src_len]  # over-length examples truncate
+            t = self.tgt[i][: self.tgt_len]
             src[row, : len(s)] = s
             tgt[row, : len(t)] = t
         return src, tgt
@@ -214,16 +215,28 @@ def load_dataset(
             raise
         test_src_lines = None
     if test_src_lines is not None:
-        tsrc = _encode_and_frame(test_src_lines, src_tok)
-        ttgt = _encode_and_frame(test_tgt_lines, tgt_tok)
-        # No length filter on test (reference ``utils.py:157-159``) — instead
-        # pad to one rounded-up max so eval compiles once.
+        def _truncate_keep_eos(arrs: list[np.ndarray], eos: int) -> list[np.ndarray]:
+            # Over-length eval examples are cut to fit the positional table,
+            # but keep the EOS frame token the model always trained with.
+            return [
+                a if len(a) <= sequence_length
+                else np.concatenate([a[: sequence_length - 1], [eos]]).astype(np.int32)
+                for a in arrs
+            ]
+
+        tsrc = _truncate_keep_eos(_encode_and_frame(test_src_lines, src_tok), src_tok.eos_id)
+        ttgt = _truncate_keep_eos(_encode_and_frame(test_tgt_lines, tgt_tok), tgt_tok.eos_id)
+        # No length *filter* on test (reference ``utils.py:157-159``) — pad to
+        # one rounded-up max so eval compiles once, but cap at
+        # ``sequence_length``: the positional table is sized to it, so longer
+        # examples are truncated rather than crashing eval (the reference only
+        # survived these because its table was vocab-sized, quirk §2.3.5).
         test = Seq2SeqDataset(
             tsrc,
             ttgt,
             batch_size=batch_size,
-            src_len=_round_up(max(len(a) for a in tsrc)),
-            tgt_len=_round_up(max(len(a) for a in ttgt)),
+            src_len=min(_round_up(max(len(a) for a in tsrc)), sequence_length),
+            tgt_len=min(_round_up(max(len(a) for a in ttgt)), sequence_length),
             shuffle=False,
             drop_remainder=False,
             shard_index=shard_index,

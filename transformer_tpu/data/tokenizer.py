@@ -67,6 +67,7 @@ class SubwordTokenizer:
         if len(self._piece_to_id) != len(self.subwords):
             raise ValueError("duplicate subwords in vocabulary")
         self._max_piece_len = max(len(p) for p in self.subwords)
+        self._native = None  # lazily-built C++ encoder (False = unavailable)
 
     # ------------------------------------------------------------------ sizes
     @property
@@ -114,9 +115,22 @@ class SubwordTokenizer:
                 out.append(match_id)
         return out
 
+    def _native_encoder(self):
+        if self._native is None:
+            from transformer_tpu import native
+
+            self._native = native.NativeTokenizer.from_pieces(self.subwords) or False
+        return self._native or None
+
     def encode(self, text: str) -> list[int]:
+        words = text.split()
+        if not words:
+            return []
+        nat = self._native_encoder()
+        if nat is not None:
+            return nat.encode_words(words)
         ids: list[int] = []
-        for word in text.split():
+        for word in words:
             ids.extend(self._encode_symbols(_word_to_symbols(word)))
         return ids
 
@@ -169,10 +183,19 @@ class SubwordTokenizer:
         """Train BPE until ``target_vocab_size`` pieces (or until no pair
         occurs ``min_pair_count`` times). Incremental pair-count maintenance
         with a lazy max-heap — full recounts per merge would be quadratic and
-        unusable at 2^15 on a 1-core host."""
+        unusable at 2^15 on a 1-core host. Prefers the bit-identical C++
+        trainer (transformer_tpu/native) when available."""
         word_freq: Counter[str] = Counter()
         for line in corpus:
             word_freq.update(line.split())
+
+        from transformer_tpu import native
+
+        nat = native.NativeTokenizer.train(
+            word_freq, target_vocab_size, min_pair_count
+        )
+        if nat is not None:
+            return cls(nat.pieces())
 
         words: list[list[str]] = []
         freqs: list[int] = []
@@ -283,6 +306,11 @@ class SubwordTokenizer:
 
     def __len__(self) -> int:
         return self.vocab_size
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_native"] = None  # ctypes handle is not picklable; rebuilt lazily
+        return state
 
 
 def iter_lines(*paths: str) -> Iterator[str]:

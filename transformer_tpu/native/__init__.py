@@ -1,0 +1,189 @@
+"""Native (C++) runtime extensions, loaded via ctypes.
+
+The reference gets its native speed from TensorFlow's C++ runtime (tf.data
+pipeline, tfds SubwordTextEncoder); this package is the framework-owned
+equivalent: a small C++ library compiled on first use with the system
+toolchain and bound through ctypes (no pybind11 dependency).
+
+Components:
+  - tokenizer.cc — BPE trainer + greedy longest-match encoder, bit-identical
+    to transformer_tpu/data/tokenizer.py (the fallback path).
+
+The library is built lazily into this directory. Disable entirely (pure
+Python fallback) with ``TRANSFORMER_TPU_NO_NATIVE=1``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libtpu_native.so")
+_SOURCES = ["tokenizer.cc"]
+
+_lib: ctypes.CDLL | bool | None = None  # None = not tried, False = unavailable
+
+
+def _build() -> str | None:
+    """Compile the shared library if missing/stale; returns its path or None."""
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    if os.path.exists(_LIB_PATH) and all(
+        os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in srcs
+    ):
+        return _LIB_PATH
+    # Build into a temp file then atomically rename, so concurrent importers
+    # (multi-host training) never load a half-written library.
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        cxx = os.environ.get("CXX", "g++")
+        cmd = [
+            cxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, *srcs,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        os.replace(tmp, _LIB_PATH)
+        return _LIB_PATH
+    except (OSError, subprocess.SubprocessError):
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return None
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, or None if disabled/unbuildable."""
+    global _lib
+    if _lib is False:
+        return None
+    if _lib is not None:
+        return _lib
+    if os.environ.get("TRANSFORMER_TPU_NO_NATIVE"):
+        _lib = False
+        return None
+    path = _build()
+    if path is None:
+        _lib = False
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        _lib = False
+        return None
+    lib.tpu_tok_create.restype = ctypes.c_void_p
+    lib.tpu_tok_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tpu_tok_train.restype = ctypes.c_void_p
+    lib.tpu_tok_train.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    lib.tpu_tok_free.restype = None
+    lib.tpu_tok_free.argtypes = [ctypes.c_void_p]
+    lib.tpu_tok_num_pieces.restype = ctypes.c_int32
+    lib.tpu_tok_num_pieces.argtypes = [ctypes.c_void_p]
+    lib.tpu_tok_pieces_blob.restype = ctypes.c_int64
+    lib.tpu_tok_pieces_blob.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.tpu_tok_encode.restype = ctypes.c_int64
+    lib.tpu_tok_encode.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+    ]
+    _lib = lib
+    return lib
+
+
+class NativeTokenizer:
+    """ctypes handle to a C++ tokenizer; owns the underlying object."""
+
+    def __init__(self, handle: int, lib: ctypes.CDLL):
+        self._handle = ctypes.c_void_p(handle)
+        self._lib = lib
+
+    def __del__(self):  # noqa: D105
+        h, self._handle = self._handle, None
+        if h:
+            self._lib.tpu_tok_free(h)
+
+    @classmethod
+    def from_pieces(cls, pieces: list[str]) -> "NativeTokenizer | None":
+        lib = get_lib()
+        if lib is None:
+            return None
+        blob = "\n".join(pieces).encode("utf-8")
+        handle = lib.tpu_tok_create(blob, len(blob))
+        return cls(handle, lib) if handle else None
+
+    @classmethod
+    def train(
+        cls,
+        word_freq: "dict[str, int]",
+        target_vocab_size: int,
+        min_pair_count: int,
+    ) -> "NativeTokenizer | None":
+        """Train BPE over a {unique word: count} mapping in first-occurrence
+        order (whitespace splitting and counting stay in Python so
+        ``str.split()``/``Counter`` semantics are preserved exactly)."""
+        lib = get_lib()
+        if lib is None:
+            return None
+        blob = "\n".join(word_freq).encode("utf-8")
+        counts = np.fromiter(
+            word_freq.values(), dtype=np.int64, count=len(word_freq)
+        )
+        handle = lib.tpu_tok_train(
+            blob,
+            len(blob),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(word_freq),
+            target_vocab_size,
+            min_pair_count,
+        )
+        return cls(handle, lib) if handle else None
+
+    def pieces(self) -> list[str]:
+        need = self._lib.tpu_tok_pieces_blob(self._handle, None, 0)
+        buf = ctypes.create_string_buffer(int(need))
+        self._lib.tpu_tok_pieces_blob(self._handle, buf, need)
+        blob = buf.raw[:need].decode("utf-8")
+        return [p for p in blob.split("\n") if p]
+
+    def encode_words(self, words: list[str]) -> list[int]:
+        if not words:
+            return []
+        blob = "\n".join(words).encode("utf-8")
+        # Each output id consumes >=1 input byte, +1 word-end marker per word.
+        cap = len(blob) + len(words) + 8
+        out = np.empty(cap, dtype=np.int32)
+        n = self._lib.tpu_tok_encode(
+            self._handle,
+            blob,
+            len(blob),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+        )
+        if n > cap:  # defensive: cap bound above should always suffice
+            out = np.empty(int(n), dtype=np.int32)
+            n = self._lib.tpu_tok_encode(
+                self._handle,
+                blob,
+                len(blob),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                int(n),
+            )
+        return out[:n].tolist()

@@ -38,8 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-_MASKED = -1e30
-_MASK_GUARD = -1e29
+from transformer_tpu.kernels.flash_attention import _MASK_GUARD, _MASKED
 
 
 def ring_attention(
