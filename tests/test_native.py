@@ -126,6 +126,16 @@ class TestNativeEncodeParity:
         assert nat.encode_words(text.split()) == self._python_encode(tok, text)
 
 
+class TestIncompleteVocab:
+    def test_native_path_disabled_without_byte_tokens(self, lib):
+        """A hand-built vocab missing <0xNN> byte tokens must not engage the
+        native encoder (whose fallback cannot raise like Python's does)."""
+        tok = SubwordTokenizer(["ab", "_"])
+        assert tok._native_encoder() is None
+        with pytest.raises(KeyError):
+            tok.encode("xy")
+
+
 class TestNativeSpeed:
     def test_native_encode_not_slower(self, lib):
         # Sanity only (no strict perf assert on shared CI hosts): native path

@@ -57,6 +57,7 @@ def define_flags() -> None:
     flags.DEFINE_integer("fsdp", 1, "fsdp (param-shard) mesh size")
     flags.DEFINE_integer("tp", 1, "tensor-parallel mesh size")
     flags.DEFINE_integer("sp", 1, "sequence-parallel mesh size")
+    flags.DEFINE_integer("pp", 1, "pipeline-parallel mesh size (GPipe stages)")
 
 
 def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> ModelConfig:
@@ -96,9 +97,11 @@ def flags_to_train_config() -> TrainConfig:
 
 
 def flags_to_mesh_config(n_devices: int) -> MeshConfig:
-    non_dp = FLAGS.fsdp * FLAGS.tp * FLAGS.sp
+    non_dp = FLAGS.fsdp * FLAGS.tp * FLAGS.sp * FLAGS.pp
     dp = FLAGS.dp or max(1, n_devices // non_dp)
-    return MeshConfig(data=dp, fsdp=FLAGS.fsdp, model=FLAGS.tp, seq=FLAGS.sp)
+    return MeshConfig(
+        data=dp, fsdp=FLAGS.fsdp, model=FLAGS.tp, seq=FLAGS.sp, pipe=FLAGS.pp
+    )
 
 
 def maybe_force_platform() -> None:

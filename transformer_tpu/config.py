@@ -116,6 +116,9 @@ class TrainConfig:
     ckpt_path: str = "model_dist"
     enable_function: bool = True  # jit on/off — the reference's eager-debug flag
     seed: int = 0
+    # GPipe microbatches per step when the mesh has a pipe axis; 0 = one
+    # microbatch per stage (parallel/pipeline.py).
+    pp_microbatches: int = 0
 
     def __post_init__(self) -> None:
         if self.loss_normalization not in ("tokens", "batch"):
@@ -134,24 +137,27 @@ class MeshConfig:
     - ``fsdp``: parameter/optimizer sharding (zero-style), rides the data axis
     - ``model``: tensor parallelism (attention heads / dff)
     - ``seq``: sequence/context parallelism (ring attention over ICI)
+    - ``pipe``: pipeline parallelism (GPipe microbatch schedule, activations
+      ppermute between stages — ``parallel/pipeline.py``)
     """
 
     data: int = 1
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.model * self.seq
+        return self.data * self.fsdp * self.model * self.seq * self.pipe
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("data", "fsdp", "model", "seq")
+        return ("data", "fsdp", "model", "seq", "pipe")
 
     @property
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.model, self.seq)
+        return (self.data, self.fsdp, self.model, self.seq, self.pipe)
 
 
 def config_to_json(cfg: Any) -> str:

@@ -117,9 +117,17 @@ class SubwordTokenizer:
 
     def _native_encoder(self):
         if self._native is None:
-            from transformer_tpu import native
+            # The C++ byte fallback requires every <0xNN> token (it cannot
+            # raise KeyError like the Python path does on an incomplete
+            # hand-built vocab) — only engage it for full alphabets.
+            if all(_byte_token(b) in self._piece_to_id for b in range(256)):
+                from transformer_tpu import native
 
-            self._native = native.NativeTokenizer.from_pieces(self.subwords) or False
+                self._native = (
+                    native.NativeTokenizer.from_pieces(self.subwords) or False
+                )
+            else:
+                self._native = False
         return self._native or None
 
     def encode(self, text: str) -> list[int]:

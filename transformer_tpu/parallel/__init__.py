@@ -23,9 +23,19 @@ from transformer_tpu.parallel.distributed import (
     make_sharded_steps,
     put_batch,
 )
+from transformer_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipelined_transformer_apply,
+    stack_layer_params,
+    unstack_layer_params,
+)
 
 __all__ = [
     "DistributedTrainer",
+    "pipeline_apply",
+    "pipelined_transformer_apply",
+    "stack_layer_params",
+    "unstack_layer_params",
     "batch_spec",
     "create_sharded_state",
     "make_mesh",

@@ -38,6 +38,27 @@ def create_sharded_state(
     return state, shardings
 
 
+def _pipelined_forward(
+    mesh: Mesh, model_cfg: ModelConfig, train_cfg: TrainConfig
+) -> Callable:
+    """GPipe forward for meshes with a ``pipe`` axis: parameters stay in the
+    regular (unstacked) tree — stacking happens at trace time inside
+    ``pipelined_transformer_apply`` — so state, optimizer, checkpointing and
+    shardings are untouched; only the forward changes."""
+    from transformer_tpu.parallel.pipeline import pipelined_transformer_apply
+
+    num_mb = train_cfg.pp_microbatches or mesh.shape["pipe"]
+
+    def forward(params, src, tar_inp, rng, deterministic):
+        return pipelined_transformer_apply(
+            params, src, tar_inp, model_cfg,
+            mesh=mesh, num_microbatches=num_mb,
+            rng=None if deterministic else rng, deterministic=deterministic,
+        )
+
+    return forward
+
+
 def make_sharded_steps(
     mesh: Mesh,
     model_cfg: ModelConfig,
@@ -46,20 +67,28 @@ def make_sharded_steps(
     shard_seq: bool = False,
     donate: bool = True,
 ) -> tuple[Callable, Callable]:
-    """jit the train/eval steps with explicit in/out shardings over ``mesh``."""
+    """jit the train/eval steps with explicit in/out shardings over ``mesh``.
+
+    A mesh with ``pipe > 1`` swaps in the GPipe-pipelined forward; all other
+    axes keep the plain SPMD-sharded step."""
     data_sh = NamedSharding(mesh, batch_spec(mesh, shard_seq))
     repl = NamedSharding(mesh, P())
     metrics_sh = {
         "loss": repl, "loss_sum": repl, "weight": repl, "correct": repl
     }
+    forward_fn = (
+        _pipelined_forward(mesh, model_cfg, train_cfg)
+        if mesh.shape.get("pipe", 1) > 1
+        else None
+    )
     train_step = jax.jit(
-        make_train_step(model_cfg, train_cfg),
+        make_train_step(model_cfg, train_cfg, forward_fn=forward_fn),
         in_shardings=(shardings, data_sh, data_sh, repl),
         out_shardings=(shardings, metrics_sh),
         donate_argnums=(0,) if donate else (),
     )
     eval_step = jax.jit(
-        make_eval_step(model_cfg, train_cfg),
+        make_eval_step(model_cfg, train_cfg, forward_fn=forward_fn),
         in_shardings=(shardings, data_sh, data_sh),
         out_shardings=metrics_sh,
     )
@@ -104,6 +133,22 @@ class DistributedTrainer(Trainer):
                 f"by data×fsdp = {mesh.shape['data'] * mesh.shape['fsdp']} "
                 "(reference check: distributed_train.py:154-158)"
             )
+        n_stages = mesh.shape.get("pipe", 1)
+        if n_stages > 1:
+            if model_cfg.num_layers % n_stages:
+                raise ValueError(
+                    f"pipe axis size {n_stages} must divide num_layers "
+                    f"{model_cfg.num_layers}"
+                )
+            per_shard = train_cfg.batch_size // (
+                mesh.shape["data"] * mesh.shape["fsdp"]
+            )
+            num_mb = train_cfg.pp_microbatches or n_stages
+            if per_shard % num_mb:
+                raise ValueError(
+                    f"pp_microbatches {num_mb} must divide the per-data-shard "
+                    f"batch {per_shard}"
+                )
         rng = rng if rng is not None else jax.random.PRNGKey(train_cfg.seed)
         state, shardings = create_sharded_state(rng, model_cfg, train_cfg, mesh)
         self.mesh = mesh

@@ -2,14 +2,16 @@
 
 Replaces the reference's replica topology (an explicit ``'/device:GPU:i'``
 list handed to MirroredStrategy, ``distributed_train.py:137-138``) with a
-logical 4-axis mesh:
+logical 5-axis mesh:
 
-    ('data', 'fsdp', 'model', 'seq')
+    ('data', 'fsdp', 'model', 'seq', 'pipe')
 
 - gradients psum over 'data'+'fsdp' (ICI),
 - parameters/optimizer shard over 'fsdp',
 - attention heads / dff shard over 'model',
-- sequence blocks shard over 'seq' (ring attention).
+- sequence blocks shard over 'seq' (ring attention),
+- layer-stack stages over 'pipe' (GPipe schedule; activations hop
+  stage-to-stage via ppermute — ``parallel/pipeline.py``).
 
 TPU pods are multi-process by construction — ``initialize_distributed`` wraps
 ``jax.distributed.initialize`` so the same entry point works single-host (no-op)

@@ -1,0 +1,257 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pipe`` mesh axis.
+
+No reference counterpart exists (SURVEY.md §2.4 — the reference's only
+strategy is mirrored data parallelism, ``distributed_train.py:137-139``); this
+is net-new TPU-native machinery. Design:
+
+- Layer parameters for the N homogeneous layers of a stack are *stacked* on a
+  leading axis and sharded over ``pipe``: each device (stage) holds
+  ``N / pipe`` contiguous layers and scans over them locally.
+- The batch is split into M microbatches. A ``lax.scan`` over
+  ``T = M + P - 1`` ticks runs the classic GPipe schedule: at tick ``t``
+  stage ``s`` processes microbatch ``t - s``; activations hop to the next
+  stage via ``lax.ppermute`` over ICI (a nearest-neighbour link on a ring
+  mesh axis, the same transport ring attention uses).
+- Stage 0 feeds from the microbatch buffer; the last stage's outputs are
+  collected and ``psum``-broadcast over ``pipe`` so every device returns the
+  full output (activations are microbatch-sized, so the broadcast is cheap
+  relative to the FLOPs it closes over).
+
+The schedule runs under ``shard_map``, so it composes with the ``data`` axis
+(batch-dim sharding splits the microbatches per data-parallel group and the
+schedule runs identically in each group). Tensor-sharding stage *interiors*
+over ``model``/``fsdp`` is not wired through this path — stages hold their
+layers whole.
+
+Everything is differentiable: ``ppermute``/``psum`` have transposes, so
+``jax.grad`` through ``pipeline_apply`` yields exactly the backward schedule
+(activations are rematerialized per microbatch by XLA as usual).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Any
+
+
+def stack_layer_params(layers: Sequence[Params]) -> Params:
+    """Stack a list of per-layer parameter trees into one tree whose leaves
+    have a leading layer axis (shardable over ``pipe``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layer_params(stacked: Params, num_layers: int) -> list[Params]:
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(num_layers)]
+
+
+def pipeline_apply(
+    stacked_params: Params,
+    layer_fn: Callable[..., jax.Array],
+    x: jax.Array,
+    mb_consts: tuple[jax.Array, ...] = (),
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    base_rng: jax.Array | None = None,
+    axis: str = "pipe",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+) -> jax.Array:
+    """Run a homogeneous layer stack over ``x`` with the GPipe schedule.
+
+    Args:
+      stacked_params: layer params stacked on a leading axis of size
+        ``num_layers`` (the ``pipe`` mesh axis size must divide it).
+      layer_fn: ``layer_fn(layer_params, x, rng, *consts) -> x`` applying ONE
+        layer; ``rng`` is None when ``base_rng`` is None (deterministic).
+      x: ``(B, ...)`` activations (e.g. post-embedding ``(B, S, D)``).
+      mb_consts: per-example side inputs streamed with the schedule (masks,
+        cross-attention memory) — each ``(B, ...)``, microbatched like ``x``.
+      num_microbatches: M; must divide the per-data-shard batch.
+      base_rng: optional dropout seed; folded per (layer, microbatch) so the
+        pipelined run matches a sequential run that folds the same way.
+      batch_axes: mesh axes the batch dimension is sharded over.
+
+    Returns ``(B, ...)`` outputs, replicated over ``pipe``.
+    """
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    n_stages = mesh.shape[axis]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"pipe axis size {n_stages} must divide num_layers {num_layers}"
+        )
+
+    params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    bspec = P(batch_axes)  # batch dim sharded, rest replicated
+    consts_spec = tuple(P(batch_axes) for _ in mb_consts)
+    rng_spec = P()
+
+    M = num_microbatches
+    T = M + n_stages - 1
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(params_spec, bspec, consts_spec, rng_spec),
+        out_specs=bspec,
+        check_vma=False,
+    )
+    def _pipelined(local_params, x_local, consts_local, rng):
+        batch = x_local.shape[0]
+        if batch % M:
+            raise ValueError(
+                f"num_microbatches {M} must divide the per-shard batch {batch}"
+            )
+        mb = batch // M
+        x_mbs = x_local.reshape(M, mb, *x_local.shape[1:])
+        consts_mbs = tuple(
+            c.reshape(M, mb, *c.shape[1:]) for c in consts_local
+        )
+        stage = jax.lax.axis_index(axis)
+        layers_per_stage = num_layers // n_stages
+
+        def apply_stage(h, mb_idx):
+            consts_mb = tuple(c[mb_idx] for c in consts_mbs)
+
+            def one_layer(h, xs):
+                local_i, lp = xs
+                if base_rng is None:
+                    r = None
+                else:
+                    global_layer = stage * layers_per_stage + local_i
+                    r = jax.random.fold_in(
+                        jax.random.fold_in(rng, global_layer), mb_idx
+                    )
+                return layer_fn(lp, h, r, *consts_mb), None
+
+            h, _ = jax.lax.scan(
+                one_layer, h, (jnp.arange(layers_per_stage), local_params)
+            )
+            return h
+
+        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(buf, t):
+            mb_idx = jnp.clip(t - stage, 0, M - 1)
+            inp = jnp.where(stage == 0, x_mbs[jnp.clip(t, 0, M - 1)], buf)
+            out = apply_stage(inp, mb_idx)
+            if n_stages > 1:
+                nxt = jax.lax.ppermute(out, axis, fwd_perm)
+            else:
+                nxt = out
+            return nxt, out
+
+        _, outs = jax.lax.scan(tick, jnp.zeros_like(x_mbs[0]), jnp.arange(T))
+        # outs[t] on the last stage holds microbatch t-(P-1); earlier stages
+        # hold in-flight garbage. Select + broadcast.
+        result = outs[n_stages - 1 :]
+        is_last = (stage == n_stages - 1).astype(result.dtype)
+        result = jax.lax.psum(result * is_last, axis)
+        return result.reshape(batch, *x_local.shape[1:])
+
+    return _pipelined(stacked_params, x, mb_consts, base_rng if base_rng is not None else jax.random.PRNGKey(0))
+
+
+# --------------------------------------------------------------------------
+# Model-level integration: pipelined encoder/decoder stacks + full forward.
+# --------------------------------------------------------------------------
+
+
+def pipelined_transformer_apply(
+    params: Params,
+    inp: jax.Array | None,
+    tar: jax.Array,
+    cfg,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    pad_id: int = 0,
+) -> jax.Array:
+    """Pipeline-parallel counterpart of ``models.transformer.transformer_apply``
+    (same logits, no attention-weight plumbing): embedding prologue and final
+    projection run replicated on every stage (they are tiny next to the layer
+    stacks); the encoder and decoder layer stacks run under the GPipe schedule.
+
+    Layer params are stacked on entry — callers that jit this (they should)
+    pay that restructuring once at trace time.
+    """
+    from transformer_tpu.models.decoder import decoder_layer_apply
+    from transformer_tpu.models.encoder import embed_prologue, encoder_layer_apply
+    from transformer_tpu.models.transformer import _logits
+    from transformer_tpu.ops.masks import make_padding_mask
+    from transformer_tpu.ops.nn import layernorm_apply
+
+    if rng is None:
+        r_embed_e = r_embed_d = r_enc = r_dec = None
+    else:
+        r_embed_e, r_embed_d, r_enc, r_dec = jax.random.split(rng, 4)
+
+    if cfg.decoder_only:
+        self_mask = make_padding_mask(tar, pad_id)
+        x = embed_prologue(
+            params["decoder"]["embedding"], tar, cfg, r_embed_d, deterministic
+        )
+        stacked = stack_layer_params(params["decoder"]["layers"])
+
+        def dec_layer(lp, h, r, smask):
+            return decoder_layer_apply(
+                lp, h, None, smask, None, cfg, r, deterministic
+            )[0]
+
+        x = pipeline_apply(
+            stacked, dec_layer, x, (self_mask,),
+            mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
+        )
+        if cfg.norm_scheme == "pre":
+            x = layernorm_apply(
+                params["decoder"]["final_ln"], x, cfg.layernorm_epsilon
+            )
+        return _logits(params, x, cfg)
+
+    enc_mask = make_padding_mask(inp, pad_id)
+    self_mask = make_padding_mask(tar, pad_id)
+
+    x = embed_prologue(
+        params["encoder"]["embedding"], inp, cfg, r_embed_e, deterministic
+    )
+    enc_stacked = stack_layer_params(params["encoder"]["layers"])
+
+    def enc_layer(lp, h, r, mask):
+        return encoder_layer_apply(lp, h, mask, cfg, r, deterministic)[0]
+
+    enc_out = pipeline_apply(
+        enc_stacked, enc_layer, x, (enc_mask,),
+        mesh=mesh, num_microbatches=num_microbatches, base_rng=r_enc,
+    )
+    if cfg.norm_scheme == "pre":
+        enc_out = layernorm_apply(
+            params["encoder"]["final_ln"], enc_out, cfg.layernorm_epsilon
+        )
+
+    y = embed_prologue(
+        params["decoder"]["embedding"], tar, cfg, r_embed_d, deterministic
+    )
+    dec_stacked = stack_layer_params(params["decoder"]["layers"])
+
+    def dec_layer(lp, h, r, enc_mb, smask, cmask):
+        return decoder_layer_apply(
+            lp, h, enc_mb, smask, cmask, cfg, r, deterministic
+        )[0]
+
+    y = pipeline_apply(
+        dec_stacked, dec_layer, y, (enc_out, self_mask, enc_mask),
+        mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
+    )
+    if cfg.norm_scheme == "pre":
+        y = layernorm_apply(
+            params["decoder"]["final_ln"], y, cfg.layernorm_epsilon
+        )
+    return _logits(params, y, cfg)

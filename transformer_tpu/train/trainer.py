@@ -40,24 +40,28 @@ def make_train_step(
     model_cfg: ModelConfig,
     train_cfg: TrainConfig,
     tx: optax.GradientTransformation | None = None,
+    forward_fn: Callable | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Build the (jittable) train step: forward, masked CE, grad, Adam update.
 
     The returned function is pure — jit it (single chip), or jit with
     shardings (distributed): gradients summed across the ``data`` axis emerge
     from XLA's psum with no explicit collective here.
+
+    ``forward_fn(params, src, tar_inp, rng, deterministic) -> logits``
+    overrides the forward pass (e.g. the GPipe-pipelined forward when the
+    mesh has a ``pipe`` axis); default is the plain ``transformer_apply``.
     """
     tx = tx or make_optimizer(model_cfg, train_cfg)
+    if forward_fn is None:
+        forward_fn = _default_forward(model_cfg)
 
     def train_step(state: TrainState, src, tgt, rng):
         tar_inp, tar_out = _shift_targets(tgt)
         step_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
-            logits, _ = transformer_apply(
-                params, src, tar_inp, model_cfg,
-                rng=step_rng, deterministic=False,
-            )
+            logits = forward_fn(params, src, tar_inp, step_rng, False)
             return masked_cross_entropy(
                 logits, tar_out,
                 label_smoothing=train_cfg.label_smoothing,
@@ -76,16 +80,29 @@ def make_train_step(
     return train_step
 
 
+def _default_forward(model_cfg: ModelConfig) -> Callable:
+    def forward(params, src, tar_inp, rng, deterministic):
+        logits, _ = transformer_apply(
+            params, src, tar_inp, model_cfg,
+            rng=None if deterministic else rng, deterministic=deterministic,
+        )
+        return logits
+
+    return forward
+
+
 def make_eval_step(
-    model_cfg: ModelConfig, train_cfg: TrainConfig
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    forward_fn: Callable | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
     """Forward-only eval step (reference ``test_step``, ``train.py:144-157``)."""
+    if forward_fn is None:
+        forward_fn = _default_forward(model_cfg)
 
     def eval_step(state: TrainState, src, tgt):
         tar_inp, tar_out = _shift_targets(tgt)
-        logits, _ = transformer_apply(
-            state.params, src, tar_inp, model_cfg, deterministic=True
-        )
+        logits = forward_fn(state.params, src, tar_inp, None, True)
         loss, metrics = masked_cross_entropy(
             logits, tar_out,
             label_smoothing=train_cfg.label_smoothing,
