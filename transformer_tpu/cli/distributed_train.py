@@ -70,6 +70,8 @@ def main(argv) -> None:
     import datetime
 
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    from transformer_tpu.cli.flags import flags_to_profiler
+
     trainer = DistributedTrainer(
         model_cfg, train_cfg, mesh,
         log_dir=os.path.join(FLAGS.tb_log_dir, stamp)
@@ -77,6 +79,7 @@ def main(argv) -> None:
         else None,
         checkpoint=ckpt,
         log_fn=logging.info,
+        profiler=flags_to_profiler() if jax.process_index() == 0 else None,
     )
     trainer.fit(train_ds, test_ds)
 

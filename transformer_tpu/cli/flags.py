@@ -52,6 +52,9 @@ def define_flags() -> None:
     flags.DEFINE_string("tb_log_dir", "logs", "TensorBoard log root")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    flags.DEFINE_string("profile_dir", "", "capture a jax.profiler trace into this dir")
+    flags.DEFINE_integer("profile_start_step", 2, "first step of the profile window")
+    flags.DEFINE_integer("profile_num_steps", 3, "profile window length in steps")
     # --- mesh knobs (distributed) ---
     flags.DEFINE_integer("dp", 0, "data-parallel mesh size (0 = all devices)")
     flags.DEFINE_integer("fsdp", 1, "fsdp (param-shard) mesh size")
@@ -93,6 +96,19 @@ def flags_to_train_config() -> TrainConfig:
         ckpt_path=FLAGS.ckpt_path,
         enable_function=FLAGS.enable_function,
         seed=FLAGS.seed,
+    )
+
+
+def flags_to_profiler():
+    """Profiler from --profile_* flags, or None when profiling is off."""
+    if not FLAGS.profile_dir:
+        return None
+    from transformer_tpu.utils.profiling import Profiler
+
+    return Profiler(
+        FLAGS.profile_dir,
+        start_step=FLAGS.profile_start_step,
+        num_steps=FLAGS.profile_num_steps,
     )
 
 

@@ -62,11 +62,14 @@ def main(argv) -> None:
     import datetime
 
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    from transformer_tpu.cli.flags import flags_to_profiler
+
     trainer = Trainer(
         model_cfg, train_cfg, state,
         log_dir=os.path.join(FLAGS.tb_log_dir, stamp),
         checkpoint=ckpt,
         log_fn=logging.info,
+        profiler=flags_to_profiler(),
     )
     trainer.fit(train_ds, test_ds)
 

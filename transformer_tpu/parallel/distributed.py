@@ -135,6 +135,18 @@ class DistributedTrainer(Trainer):
             )
         n_stages = mesh.shape.get("pipe", 1)
         if n_stages > 1:
+            unsupported = {
+                a: mesh.shape[a]
+                for a in ("fsdp", "model", "seq")
+                if mesh.shape.get(a, 1) > 1
+            }
+            if unsupported:
+                raise ValueError(
+                    f"pipe>1 composes only with the 'data' axis for now; got "
+                    f"{unsupported}. The GPipe schedule holds stage layers "
+                    "whole (parallel/pipeline.py), so fsdp/model/seq sharding "
+                    "inside stages is not wired through this path."
+                )
             if model_cfg.num_layers % n_stages:
                 raise ValueError(
                     f"pipe axis size {n_stages} must divide num_layers "

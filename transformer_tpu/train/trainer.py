@@ -27,6 +27,8 @@ from transformer_tpu.models import transformer_apply
 from transformer_tpu.train.checkpoint import CheckpointManager
 from transformer_tpu.train.loss import masked_cross_entropy
 from transformer_tpu.train.state import TrainState, make_optimizer
+from transformer_tpu.utils.preemption import PreemptionGuard
+from transformer_tpu.utils.profiling import Profiler, StepTimer
 from transformer_tpu.utils.tensorboard import SummaryWriter
 
 
@@ -179,12 +181,17 @@ class Trainer:
         checkpoint: CheckpointManager | None = None,
         donate_state: bool = True,
         log_fn: Callable[[str], None] = print,
+        profiler: "Profiler | None" = None,
     ) -> None:
         self.model_cfg = model_cfg
         self.train_cfg = train_cfg
         self.state = state
         self.checkpoint = checkpoint
         self.log_fn = log_fn
+        self.profiler = profiler
+        self.step_timer = StepTimer(
+            tokens_per_step=train_cfg.batch_size * train_cfg.sequence_length
+        )
         self.train_metrics = MetricAccumulator()
         self.eval_metrics = MetricAccumulator()
         self.writers = {}
@@ -205,11 +212,18 @@ class Trainer:
         self.eval_step = eval_step
 
     # ------------------------------------------------------------------ loop
-    def evaluate(self, batches: Iterable, max_batches: int | None = None) -> None:
+    def evaluate(
+        self,
+        batches: Iterable,
+        max_batches: int | None = None,
+        guard: "PreemptionGuard | None" = None,
+    ) -> None:
         self.eval_metrics.reset()
         for i, (src, tgt) in enumerate(batches):
             if max_batches is not None and i >= max_batches:
                 break
+            if guard is not None and guard.should_stop:
+                return  # preemption: abandon eval, caller checkpoints
             m = self.eval_step(self.state, src, tgt)
             self.eval_metrics.update(m)
 
@@ -226,45 +240,88 @@ class Trainer:
         # Host-side step mirror: consulting state.step (a device array) every
         # iteration would block async dispatch.
         step = int(self.state.step)
-        for epoch in range(cfg.epochs):
-            self.train_metrics.reset()
-            epoch_start = time.time()
-            for src, tgt in train_ds.batches(epoch):
-                self.state, m = self.train_step(self.state, src, tgt, rng)
-                self.train_metrics.update(m)
-                step += 1
-                if cfg.log_every_steps and step % cfg.log_every_steps == 0:
-                    self.log_fn(
-                        f"epoch {epoch + 1} step {step} "
-                        f"loss {self.train_metrics.loss:.4f} "
-                        f"acc {self.train_metrics.accuracy:.4f}"
-                    )
-                if (
-                    test_ds is not None
-                    and cfg.eval_every_steps
-                    and step % cfg.eval_every_steps == 0
-                ):
-                    # Bounded in-loop eval (fixes reference full-test-set
-                    # stall, train.py:193-195, and 1-batch quirk §2.3.3).
-                    self.evaluate(test_ds.batches(epoch), max_batches=8)
-                    self.log_fn(
-                        f"  eval loss {self.eval_metrics.loss:.4f} "
-                        f"acc {self.eval_metrics.accuracy:.4f}"
-                    )
+        with PreemptionGuard() as guard:
+            for epoch in range(cfg.epochs):
+                self.train_metrics.reset()
+                self.step_timer.reset()
+                epoch_start = time.time()
+                for src, tgt in train_ds.batches(epoch):
+                    if self.profiler is not None:
+                        self.profiler.maybe_trace(step, block_on=self.state)
+                    self.state, m = self.train_step(self.state, src, tgt, rng)
+                    self.train_metrics.update(m)
+                    self.step_timer.tick()
+                    step += 1
+                    if guard.should_stop:
+                        self._preempt(step, guard)
+                        return
+                    if cfg.log_every_steps and step % cfg.log_every_steps == 0:
+                        loss = self.train_metrics.loss  # device_get: blocks
+                        self.step_timer.sync()
+                        self.log_fn(
+                            f"epoch {epoch + 1} step {step} "
+                            f"loss {loss:.4f} "
+                            f"acc {self.train_metrics.accuracy:.4f} "
+                            f"({self.step_timer.steps_per_sec:.2f} steps/s)"
+                        )
+                    if (
+                        test_ds is not None
+                        and cfg.eval_every_steps
+                        and step % cfg.eval_every_steps == 0
+                    ):
+                        # Bounded in-loop eval (fixes reference full-test-set
+                        # stall, train.py:193-195, and 1-batch quirk §2.3.3).
+                        self.step_timer.sync()
+                        self.evaluate(
+                            test_ds.batches(epoch), max_batches=8, guard=guard
+                        )
+                        self.log_fn(
+                            f"  eval loss {self.eval_metrics.loss:.4f} "
+                            f"acc {self.eval_metrics.accuracy:.4f}"
+                        )
 
-            if test_ds is not None:
-                self.evaluate(test_ds.batches(epoch))
-            self._write_epoch_summaries(epoch)
-            self.log_fn(
-                f"epoch {epoch + 1}/{cfg.epochs} done in "
-                f"{time.time() - epoch_start:.1f}s: "
-                f"loss {self.train_metrics.loss:.4f} acc {self.train_metrics.accuracy:.4f}"
-            )
-            if self.checkpoint is not None and (
-                (epoch + 1) % cfg.checkpoint_every_epochs == 0
-                or (epoch + 1) == cfg.epochs
-            ):
-                self.checkpoint.save(self.state)
+                epoch_loss = self.train_metrics.loss  # device_get: blocks
+                self.step_timer.sync()
+                if guard.should_stop:
+                    self._preempt(step, guard)
+                    return
+                if test_ds is not None:
+                    self.evaluate(test_ds.batches(epoch), guard=guard)
+                    if guard.should_stop:
+                        self._preempt(step, guard)
+                        return
+                self._write_epoch_summaries(epoch)
+                self.log_fn(
+                    f"epoch {epoch + 1}/{cfg.epochs} done in "
+                    f"{time.time() - epoch_start:.1f}s: "
+                    f"loss {epoch_loss:.4f} "
+                    f"acc {self.train_metrics.accuracy:.4f}; "
+                    f"{self.step_timer.summary()}"
+                )
+                if self.checkpoint is not None and (
+                    (epoch + 1) % cfg.checkpoint_every_epochs == 0
+                    or (epoch + 1) == cfg.epochs
+                ):
+                    self.checkpoint.save(self.state)
+        if self.profiler is not None:
+            self.profiler.stop(block_on=self.state)
+
+    def _preempt(self, step: int, guard: "PreemptionGuard") -> None:
+        """Graceful shutdown on SIGTERM/SIGINT: checkpoint, flush, report."""
+        if self.profiler is not None:
+            self.profiler.stop(block_on=self.state)
+        prefix = f"preemption (signal {guard.signal_received}) at step {step}: "
+        if self.checkpoint is not None:
+            path = self.checkpoint.save(self.state)
+            if path is not None:
+                self.log_fn(prefix + f"checkpoint saved to {path}")
+            else:
+                # Non-primary process in a multi-host run: host 0 persists.
+                self.log_fn(prefix + "checkpoint written by primary process")
+        else:
+            self.log_fn(prefix + "no checkpoint manager configured, state lost")
+        for w in self.writers.values():
+            w.flush()
 
     def _write_epoch_summaries(self, epoch: int) -> None:
         if not self.writers:

@@ -1,6 +1,17 @@
-"""Utilities: TensorBoard event writing, BLEU, profiling helpers."""
+"""Utilities: TensorBoard event writing, BLEU, profiling/tracing,
+preemption handling, determinism audits."""
 
 from transformer_tpu.utils.bleu import corpus_bleu
+from transformer_tpu.utils.preemption import PreemptionGuard, tree_checksum
+from transformer_tpu.utils.profiling import Profiler, StepTimer, annotate
 from transformer_tpu.utils.tensorboard import SummaryWriter
 
-__all__ = ["SummaryWriter", "corpus_bleu"]
+__all__ = [
+    "PreemptionGuard",
+    "Profiler",
+    "StepTimer",
+    "SummaryWriter",
+    "annotate",
+    "corpus_bleu",
+    "tree_checksum",
+]

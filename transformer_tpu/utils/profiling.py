@@ -1,0 +1,144 @@
+"""Tracing / profiling subsystem.
+
+The reference's only observability is wall-clock prints (``train.py:186,213``,
+``distributed_train.py:76,81,99,121``) plus loss/accuracy scalars; its
+de-facto "debug mode" is ``--enable_function=False`` (``utils.py:30``,
+``train.py:175-177``), which this framework preserves as the un-jitted eager
+path. This module is the TPU-native upgrade:
+
+- :class:`Profiler` captures an XLA device trace for a step window
+  ``[start_step, start_step + num_steps)`` via ``jax.profiler`` and writes a
+  TensorBoard-profile-compatible dump.
+- :func:`annotate` labels host-side regions so they show up on the trace
+  timeline.
+- :class:`StepTimer` keeps an online step-duration distribution and
+  throughput estimate — the structured replacement for the reference's
+  printed per-step deltas.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+
+class Profiler:
+    """Capture one jax.profiler trace over a window of training steps.
+
+    Drive it from a training loop with ``maybe_trace(step)`` once per step;
+    the trace starts when ``step == start_step`` and stops ``num_steps``
+    later (or at ``close()``, whichever comes first).
+    """
+
+    def __init__(self, log_dir: str, start_step: int = 2, num_steps: int = 3):
+        self.log_dir = log_dir
+        # Relative to the first observed step, so a run restored at step N
+        # still skips `start_step` warmup (compile) steps before tracing.
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._first_step: int | None = None
+        self._active = False
+        self._done = False
+
+    def maybe_trace(self, step: int, block_on=None) -> None:
+        if self._done:
+            return
+        if self._first_step is None:
+            self._first_step = step
+        rel = step - self._first_step
+        if not self._active and rel >= self.start_step:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._stop_at = step + self.num_steps
+        elif self._active and step >= self._stop_at:
+            self.stop(block_on)
+
+    def stop(self, block_on=None) -> None:
+        """End the capture. Pass the training state (or any output of the
+        profiled steps) as ``block_on`` so enqueued device work finishes
+        inside the trace — without it, async-dispatched steps may still be
+        running when the capture closes."""
+        if self._active:
+            if block_on is not None:
+                jax.block_until_ready(block_on)
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    close = stop
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Label a host-side region on the profiler timeline."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Step throughput from wall clock between *sync points*.
+
+    Under async dispatch a jitted step returns as soon as it is enqueued, so
+    per-call deltas measure host dispatch, not device time. This timer only
+    trusts windows closed by :meth:`sync`, which the caller invokes right
+    after a genuinely blocking read (a metric ``device_get``, an epoch
+    boundary): ``tick()`` counts steps; ``sync()`` closes the window and
+    attributes its wall time to the steps inside it.
+    """
+
+    def __init__(self, tokens_per_step: int = 0):
+        self.tokens_per_step = tokens_per_step
+        self.reset()
+
+    def reset(self) -> None:
+        self._window_steps = 0
+        self._window_start: float | None = None
+        self._total_steps = 0
+        self._total_time = 0.0
+
+    def tick(self) -> None:
+        """Call once per dispatched step."""
+        if self._window_start is None:
+            self._window_start = time.perf_counter()
+        self._window_steps += 1
+
+    def sync(self) -> None:
+        """Close the current window — call immediately after a blocking read
+        of step outputs, so the elapsed time covers completed device work."""
+        if self._window_start is None or self._window_steps == 0:
+            return
+        self._total_time += time.perf_counter() - self._window_start
+        self._total_steps += self._window_steps
+        self._window_steps = 0
+        self._window_start = None
+
+    @property
+    def count(self) -> int:
+        return self._total_steps
+
+    @property
+    def mean_s(self) -> float:
+        return self._total_time / self._total_steps if self._total_steps else 0.0
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self._total_steps / self._total_time if self._total_time > 0 else 0.0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.steps_per_sec * self.tokens_per_step
+
+    def summary(self) -> str:
+        if not self._total_steps:
+            return "no steps timed"
+        msg = (
+            f"{self.count} steps: mean {self.mean_s * 1e3:.1f}ms "
+            f"({self.steps_per_sec:.2f} steps/s"
+        )
+        if self.tokens_per_step:
+            msg += f", {self.tokens_per_sec:,.0f} tokens/s"
+        return msg + ")"
