@@ -62,6 +62,7 @@ def main(argv) -> None:
         seed=train_cfg.seed,
         shard_index=jax.process_index(),
         shard_count=jax.process_count(),
+        prefetch=FLAGS.native_loader,
     )
     model_cfg = flags_to_model_config(
         src_tok.model_vocab_size, tgt_tok.model_vocab_size

@@ -52,6 +52,8 @@ def define_flags() -> None:
     flags.DEFINE_string("tb_log_dir", "logs", "TensorBoard log root")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    flags.DEFINE_boolean("native_loader", True,
+                         "prefetch batches via the C++ loader when available")
     flags.DEFINE_string("profile_dir", "", "capture a jax.profiler trace into this dir")
     flags.DEFINE_integer("profile_start_step", 2, "first step of the profile window")
     flags.DEFINE_integer("profile_num_steps", 3, "profile window length in steps")

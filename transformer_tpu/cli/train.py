@@ -47,6 +47,7 @@ def main(argv) -> None:
         sequence_length=train_cfg.sequence_length,
         target_vocab_size=FLAGS.target_vocab_size,
         seed=train_cfg.seed,
+        prefetch=FLAGS.native_loader,
     )
     logging.info(
         "data: %d train examples, vocabs %d/%d",

@@ -106,6 +106,15 @@ class Seq2SeqDataset:
     drop_remainder: bool = True
     shard_index: int = 0
     shard_count: int = 1
+    # Opt-in C++ prefetching loader (transformer_tpu/native/dataloader.cc):
+    # batch assembly runs in a background thread, overlapped with device
+    # steps. Shuffle order differs from the Python path (splitmix64
+    # Fisher-Yates vs numpy Philox) but is equally deterministic per
+    # (seed, epoch); the unshuffled order and padding semantics are identical.
+    prefetch: bool = False
+    _native: object = dataclasses.field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     def __post_init__(self) -> None:
         if len(self.src) != len(self.tgt):
@@ -126,7 +135,48 @@ class Seq2SeqDataset:
     def num_examples(self) -> int:
         return len(self.src)
 
+    def _native_loader(self):
+        if self._native is None:
+            from transformer_tpu import native
+
+            local = self.batch_size // self.shard_count
+            self._native = (
+                native.NativeBatchLoader.create(
+                    self.src, self.tgt, self.batch_size, local,
+                    self.shard_index * local, self.src_len, self.tgt_len,
+                    pad_id=PAD_ID,
+                )
+                or False
+            )
+        return self._native or None
+
     def batches(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        if self.prefetch:
+            loader = self._native_loader()
+            if loader is not None:
+                seed = (self.seed * 0x9E3779B97F4A7C15 + epoch) & (2**64 - 1)
+                yield from loader.epoch(seed, self.shuffle, self.drop_remainder)
+                return
+            if self.shard_count > 1:
+                # The native and Python paths shuffle with different PRNGs; a
+                # host silently falling back would slice a DIFFERENT global
+                # permutation than its peers — batch corruption, not a slow
+                # path. Refuse instead.
+                raise RuntimeError(
+                    "prefetch requested but the native loader is unavailable "
+                    "on this host; with multi-host sharding a silent Python "
+                    "fallback would desynchronize the global shuffle. Build "
+                    "transformer_tpu/native (needs a C++ toolchain) or pass "
+                    "prefetch=False everywhere."
+                )
+            import warnings
+
+            warnings.warn(
+                "prefetch requested but the native loader is unavailable; "
+                "falling back to the Python batcher (different shuffle order)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         order = np.arange(len(self.src))
         if self.shuffle:
             rng = np.random.default_rng((self.seed, epoch))
@@ -174,6 +224,7 @@ def load_dataset(
     shard_index: int = 0,
     shard_count: int = 1,
     require_test: bool = False,
+    prefetch: bool = False,
 ) -> tuple[Seq2SeqDataset, Seq2SeqDataset | None, SubwordTokenizer, SubwordTokenizer]:
     """Build train (+ optional test) datasets plus both tokenizers —
     the counterpart of reference ``load_dataset`` (``utils.py:114-161``).
@@ -205,6 +256,7 @@ def load_dataset(
         seed=seed,
         shard_index=shard_index,
         shard_count=shard_count,
+        prefetch=prefetch,
     )
 
     test: Seq2SeqDataset | None = None

@@ -24,7 +24,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libtpu_native.so")
-_SOURCES = ["tokenizer.cc"]
+_SOURCES = ["tokenizer.cc", "dataloader.cc"]
 
 _lib: ctypes.CDLL | bool | None = None  # None = not tried, False = unavailable
 
@@ -32,10 +32,15 @@ _lib: ctypes.CDLL | bool | None = None  # None = not tried, False = unavailable
 def _build() -> str | None:
     """Compile the shared library if missing/stale; returns its path or None."""
     srcs = [os.path.join(_DIR, s) for s in _SOURCES]
-    if os.path.exists(_LIB_PATH) and all(
-        os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in srcs
-    ):
-        return _LIB_PATH
+    try:
+        if os.path.exists(_LIB_PATH) and all(
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in srcs
+        ):
+            return _LIB_PATH
+    except OSError:
+        # A source file is missing (incomplete checkout): a stale .so may
+        # lack symbols, so treat native as unavailable rather than crash.
+        return None
     # Build into a temp file then atomically rename, so concurrent importers
     # (multi-host training) never load a half-written library.
     tmp = None
@@ -45,6 +50,7 @@ def _build() -> str | None:
         cxx = os.environ.get("CXX", "g++")
         cmd = [
             cxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, *srcs,
+            "-lpthread",
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, _LIB_PATH)
@@ -74,9 +80,15 @@ def get_lib() -> ctypes.CDLL | None:
         return None
     try:
         lib = ctypes.CDLL(path)
-    except OSError:
+        _bind(lib)
+    except (OSError, AttributeError):  # dlopen failure or missing symbol
         _lib = False
         return None
+    _lib = lib
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.tpu_tok_create.restype = ctypes.c_void_p
     lib.tpu_tok_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.tpu_tok_train.restype = ctypes.c_void_p
@@ -104,8 +116,112 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int64,
     ]
-    _lib = lib
-    return lib
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.tpu_dl_create.restype = ctypes.c_void_p
+    lib.tpu_dl_create.argtypes = [
+        i32p, i64p, i32p, i64p,
+        ctypes.c_int64,  # n_examples
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # global/local/lo
+        ctypes.c_int32, ctypes.c_int32,  # src_len/tgt_len
+        ctypes.c_int32,  # pad_id
+        ctypes.c_int32,  # queue_depth
+    ]
+    lib.tpu_dl_free.restype = None
+    lib.tpu_dl_free.argtypes = [ctypes.c_void_p]
+    lib.tpu_dl_start_epoch.restype = None
+    lib.tpu_dl_start_epoch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.tpu_dl_next.restype = ctypes.c_int32
+    lib.tpu_dl_next.argtypes = [ctypes.c_void_p, i32p, i32p]
+
+
+class NativeBatchLoader:
+    """ctypes handle to the C++ prefetching loader; owns the native object."""
+
+    def __init__(self, handle: int, lib: ctypes.CDLL, local_batch: int,
+                 src_len: int, tgt_len: int):
+        self._handle = ctypes.c_void_p(handle)
+        self._lib = lib
+        self.local_batch = local_batch
+        self.src_len = src_len
+        self.tgt_len = tgt_len
+        self._generation = 0  # starting an epoch invalidates prior iterators
+
+    def __del__(self):  # noqa: D105
+        h, self._handle = self._handle, None
+        if h:
+            self._lib.tpu_dl_free(h)
+
+    @classmethod
+    def create(
+        cls,
+        src: list,
+        tgt: list,
+        global_batch: int,
+        local_batch: int,
+        lo: int,
+        src_len: int,
+        tgt_len: int,
+        pad_id: int = 0,
+        queue_depth: int = 3,
+    ) -> "NativeBatchLoader | None":
+        lib = get_lib()
+        if lib is None:
+            return None
+        src_off = np.zeros(len(src) + 1, dtype=np.int64)
+        np.cumsum([len(a) for a in src], out=src_off[1:])
+        tgt_off = np.zeros(len(tgt) + 1, dtype=np.int64)
+        np.cumsum([len(a) for a in tgt], out=tgt_off[1:])
+        src_flat = (
+            np.concatenate(src).astype(np.int32)
+            if len(src)
+            else np.zeros(0, np.int32)
+        )
+        tgt_flat = (
+            np.concatenate(tgt).astype(np.int32)
+            if len(tgt)
+            else np.zeros(0, np.int32)
+        )
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        handle = lib.tpu_dl_create(
+            src_flat.ctypes.data_as(i32p), src_off.ctypes.data_as(i64p),
+            tgt_flat.ctypes.data_as(i32p), tgt_off.ctypes.data_as(i64p),
+            len(src), global_batch, local_batch, lo, src_len, tgt_len,
+            pad_id, queue_depth,
+        )
+        return (
+            cls(handle, lib, local_batch, src_len, tgt_len) if handle else None
+        )
+
+    def epoch(self, seed: int, shuffle: bool, drop_remainder: bool):
+        """Start the producer and yield (src, tgt) int32 batches.
+
+        One live iterator per loader: starting a new epoch cancels the
+        in-flight one (its iterator terminates cleanly at the next pull
+        instead of stealing the new epoch's batches)."""
+        self._generation += 1
+        my_generation = self._generation
+        self._lib.tpu_dl_start_epoch(
+            self._handle,
+            ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF),
+            int(shuffle),
+            int(drop_remainder),
+        )
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        while self._generation == my_generation:
+            src = np.empty((self.local_batch, self.src_len), dtype=np.int32)
+            tgt = np.empty((self.local_batch, self.tgt_len), dtype=np.int32)
+            ok = self._lib.tpu_dl_next(
+                self._handle,
+                src.ctypes.data_as(i32p),
+                tgt.ctypes.data_as(i32p),
+            )
+            if not ok:
+                return
+            yield src, tgt
 
 
 class NativeTokenizer:
