@@ -51,17 +51,20 @@ def main() -> None:
     src = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
     tgt = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
 
-    # Warmup: compile + 2 steady steps.
+    # Warmup: compile + 2 steady steps. Synchronize via a VALUE fetch, not
+    # block_until_ready: on tunneled/remote PJRT backends block_until_ready
+    # can return before device execution finishes, inflating throughput.
     for _ in range(3):
         state, metrics = step(state, src, tgt, rng)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, src, tgt, rng)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "NaN loss"
 
     # Tokens processed per optimizer step: target tokens (the unit BLEU-side
     # throughput is quoted in). src+tgt would double-count the same sentence.

@@ -1,0 +1,189 @@
+"""Benchmark suite: train-step throughput for every BASELINE.json config.
+
+``bench.py`` at the repo root stays the driver contract (one JSON line for
+the flagship config); this runner measures all five configs and prints one
+JSON line each, for filling in BASELINE.md:
+
+    python benchmarks/run.py [--steps N] [--configs tiny,base,...]
+
+Configs (BASELINE.json "configs"):
+  tiny   2L Transformer-tiny (the CPU smoke config)
+  base   6L d_model=512 8H dff=2048 (Vaswani base)
+  big    6L d_model=1024 16H dff=4096 + label smoothing 0.1
+  tied   base + tied src/tgt embeddings + tied output projection
+  long4k 4096-token decoder-only causal LM with flash attention
+
+Throughput counts *target* tokens per optimizer step (batch × (seq−1)):
+the unit BLEU-side throughput is quoted in; src+tgt would double-count the
+same sentence pair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _configs():
+    from transformer_tpu.config import ModelConfig, TrainConfig
+
+    # (model_cfg, train_cfg, batch, seq) per benchmark point.
+    out = {}
+    out["tiny"] = (
+        ModelConfig(
+            num_layers=2, d_model=128, num_heads=4, dff=512,
+            input_vocab_size=32002, target_vocab_size=32002,
+            max_position=64, dtype="bfloat16",
+        ),
+        TrainConfig(batch_size=64, sequence_length=64, warmup_steps=4000),
+        64, 64,
+    )
+    out["base"] = (
+        ModelConfig(
+            num_layers=6, d_model=512, num_heads=8, dff=2048,
+            input_vocab_size=32002, target_vocab_size=32002,
+            max_position=64, dtype="bfloat16",
+        ),
+        TrainConfig(batch_size=64, sequence_length=64, warmup_steps=4000),
+        64, 64,
+    )
+    out["big"] = (
+        ModelConfig(
+            num_layers=6, d_model=1024, num_heads=16, dff=4096,
+            input_vocab_size=32002, target_vocab_size=32002,
+            max_position=64, dtype="bfloat16",
+        ),
+        TrainConfig(
+            batch_size=32, sequence_length=64, warmup_steps=4000,
+            label_smoothing=0.1,
+        ),
+        32, 64,
+    )
+    out["tied"] = (
+        ModelConfig(
+            num_layers=6, d_model=512, num_heads=8, dff=2048,
+            input_vocab_size=32002, target_vocab_size=32002,
+            max_position=64, dtype="bfloat16",
+            tie_embeddings=True, tie_output=True,
+        ),
+        TrainConfig(batch_size=64, sequence_length=64, warmup_steps=4000),
+        64, 64,
+    )
+    out["long4k"] = (
+        ModelConfig(
+            num_layers=6, d_model=512, num_heads=8, dff=2048,
+            input_vocab_size=32002, target_vocab_size=32002,
+            max_position=4096, dtype="bfloat16",
+            decoder_only=True, attention_impl="flash",
+        ),
+        TrainConfig(batch_size=4, sequence_length=4096, warmup_steps=4000),
+        4, 4096,
+    )
+    return out
+
+
+def bench_config(name: str, n_steps: int = 20) -> dict:
+    import jax
+    import numpy as np
+
+    from transformer_tpu.train import create_train_state, make_train_step
+
+    model_cfg, train_cfg, batch, seq = _configs()[name]
+    dev = jax.devices()[0]
+    state = create_train_state(jax.random.PRNGKey(0), model_cfg, train_cfg)
+    rng = jax.random.PRNGKey(1)
+    r = np.random.default_rng(0)
+    src = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
+    tgt = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
+
+    # Donated-state step except for tied-weight configs: donation aliases one
+    # buffer into two consumers there, which the TPU backend rejects at
+    # EXECUTION time — and a failed donated execution wedges the tunnel's
+    # claim lease (see .claude/skills/verify/SKILL.md), so decide statically
+    # rather than probing by running a doomed step.
+    donate = not (model_cfg.tie_embeddings or model_cfg.tie_output)
+    step = jax.jit(
+        make_train_step(model_cfg, train_cfg),
+        donate_argnums=(0,) if donate else (),
+    )
+    if not donate:
+        print(f"{name}: tied weights, benchmarking undonated", file=sys.stderr)
+
+    for _ in range(3):  # compile + settle
+        state, metrics = step(state, src, tgt, rng)
+    # Synchronize via a VALUE fetch, not block_until_ready: on tunneled/
+    # remote PJRT backends block_until_ready can return before device
+    # execution finishes, inflating throughput ~10x. float() cannot lie.
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, src, tgt, rng)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "NaN loss"  # keep the fetch load-bearing
+
+    tokens_per_step = batch * (seq - 1)
+    value = tokens_per_step * n_steps / dt
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    return {
+        "metric": f"{name} train throughput",
+        "value": round(value, 1),
+        "unit": "tokens/sec/chip",
+        "config": {
+            "layers": model_cfg.num_layers,
+            "d_model": model_cfg.d_model,
+            "heads": model_cfg.num_heads,
+            "dff": model_cfg.dff,
+            "batch": batch,
+            "seq": seq,
+            "decoder_only": model_cfg.decoder_only,
+            "params_millions": round(n_params / 1e6, 1),
+        },
+        "step_ms": round(dt / n_steps * 1e3, 2),
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "vs_baseline": None,  # reference publishes no numbers (BASELINE.md)
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument(
+        "--configs", default="tiny,base,big,tied,long4k",
+        help="comma-separated subset",
+    )
+    args = ap.parse_args()
+    names = [n.strip() for n in args.configs.split(",") if n.strip()]
+
+    if len(names) > 1:
+        # One subprocess per config: a backend error (e.g. a rejected donated
+        # execution) can poison the TPU client for the rest of the process.
+        import subprocess
+
+        for name in names:
+            subprocess.run(
+                [sys.executable, __file__, "--steps", str(args.steps),
+                 "--configs", name],
+                check=False,
+            )
+        return
+
+    name = names[0]
+    print(f"benchmarking {name}...", file=sys.stderr)
+    try:
+        print(json.dumps(bench_config(name, args.steps)), flush=True)
+    except Exception as e:  # record the failure as a JSON line
+        print(
+            json.dumps({"metric": f"{name} train throughput", "error": str(e)}),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

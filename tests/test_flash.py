@@ -79,6 +79,36 @@ class TestForward:
         want, _ = dot_product_attention(q, k, v)
         np.testing.assert_allclose(got, want, atol=2e-6)
 
+    @pytest.mark.parametrize("s", [63, 65, 117])
+    def test_awkward_lengths_pad_internally(self, rng, s):
+        """Lengths with no 8-aligned divisor (e.g. 4095 after the
+        teacher-forcing shift) must pad internally, not pick a lane-illegal
+        block: results still match the oracle exactly, causal and not."""
+        q, k, v = _qkv(rng, s=s)
+        got = flash_attention(q, k, v, block_q=32, block_k=32)
+        want, _ = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+        got_c = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        want_c, _ = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(got_c, want_c, atol=2e-6)
+
+    def test_awkward_length_grads(self, rng):
+        q, k, v = _qkv(rng, s=65)
+
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=32, block_k=32) ** 2).sum()
+
+        def f_xla(q, k, v):
+            mask = jnp.tril(jnp.ones((65, 65), bool))[None, None]
+            return (dot_product_attention(q, k, v, mask)[0] ** 2).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-5)
+
     def test_bfloat16(self, rng):
         q, k, v = _qkv(rng, dtype=jnp.bfloat16)
         got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)

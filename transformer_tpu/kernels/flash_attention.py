@@ -64,6 +64,25 @@ def _largest_divisor_block(seq_len: int, requested: int) -> int:
     return block
 
 
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _block_and_padded_len(seq_len: int, requested: int) -> tuple[int, int]:
+    """Pick a TPU-legal block size and the (possibly padded) sequence length.
+
+    The Mosaic lowering requires the block's sublane dim to be divisible by 8
+    or equal to the full array dim. A divisor block satisfying that is used
+    as-is (no padding); otherwise the sequence is padded up to a multiple of
+    an 8-aligned block (e.g. S=4095 -> block 128, padded to 4096 — the
+    teacher-forcing shift makes off-by-one lengths the common case)."""
+    block = _largest_divisor_block(seq_len, requested)
+    if block == seq_len or block % 8 == 0:
+        return block, seq_len
+    block = max(8, min(requested, _round_up(seq_len, 8)) // 8 * 8)
+    return block, _round_up(seq_len, block)
+
+
 def _compiler_params(dimension_semantics: tuple[str, ...]):
     try:
         return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
@@ -443,11 +462,27 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    bq, s_q_pad = _block_and_padded_len(s_q, block_q)
+    bk, s_k_pad = _block_and_padded_len(s_k, block_k)
+    pad_q, pad_k = s_q_pad - s_q, s_k_pad - s_k
+    if pad_k and kv_mask is None and not causal:
+        # Padded keys must not receive attention; under causality they sit
+        # above the diagonal for every real query row, so no mask is needed.
+        kv_mask = jnp.ones((b, s_k), dtype=jnp.int32)
+    if kv_mask is not None:
+        kv_mask = jnp.broadcast_to(kv_mask, (b, s_k))
+        if pad_k:
+            kv_mask = jnp.pad(kv_mask.astype(jnp.int32), ((0, 0), (0, pad_k)))
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+
     cfg = _FlashConfig(
         causal=causal,
         has_mask=kv_mask is not None,
-        block_q=_largest_divisor_block(s_q, block_q),
-        block_k=_largest_divisor_block(s_k, block_k),
+        block_q=bq,
+        block_k=bk,
         num_heads=h,
         scale=d**-0.5,
         interpret=bool(interpret),
@@ -462,9 +497,8 @@ def flash_attention(
     mask_i32 = (
         None
         if kv_mask is None
-        else jnp.broadcast_to(kv_mask, (b, s_k))
-        .astype(jnp.int32)
-        .reshape(b, s_k // cfg.block_k, 1, cfg.block_k)
+        else kv_mask.astype(jnp.int32).reshape(b, s_k_pad // bk, 1, bk)
     )
     out = _flash(cfg, fold(q), fold(k), fold(v), mask_i32)
-    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, s_q_pad, d).transpose(0, 2, 1, 3)
+    return out[:, :s_q] if pad_q else out
