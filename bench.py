@@ -9,16 +9,30 @@ ONE JSON line:
 
 ``vs_baseline`` is null: the reference publishes no numbers (BASELINE.md —
 README is a bare feature list), so there is nothing to normalize against.
+
+Resilience: the TPU tunnel can be transiently down (round 1 captured exactly
+that: ``jax.errors.JaxRuntimeError: UNAVAILABLE`` at backend init). A failed
+backend init is cached for the life of the process, so the measurement runs
+in a child process; the parent retries with bounded backoff and, if every
+attempt fails, emits a structured failure JSON line instead of a traceback.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
+_INNER_ENV = "_TRANSFORMER_TPU_BENCH_INNER"
+_METRIC = "transformer-base train throughput (6L/512/8H/2048, bf16, batch 64, seq 64)"
+# 0 + 15 + 30 + 60 + 120 ≈ 4 minutes of patience for a flapping tunnel.
+_RETRY_DELAYS_S = (0, 15, 30, 60, 120)
 
-def main() -> None:
+
+def _run_inner() -> None:
+    """The actual measurement. Runs in a child process (fresh backend)."""
     import jax
     import numpy as np
 
@@ -83,13 +97,78 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "transformer-base train throughput (6L/512/8H/2048, bf16, batch 64, seq 64)",
+                "metric": _METRIC,
                 "value": round(value, 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": None,
             }
         )
     )
+
+
+def _looks_retryable(text: str) -> bool:
+    """Backend-init flakiness worth retrying vs. a real bug worth surfacing."""
+    needles = (
+        "UNAVAILABLE",
+        "Unable to initialize backend",
+        "TPU backend setup/compile error",
+        "DEADLINE_EXCEEDED",
+        "failed to connect",
+    )
+    return any(n in text for n in needles)
+
+
+def main() -> None:
+    if os.environ.get(_INNER_ENV) == "1":
+        _run_inner()
+        return
+
+    last_err = ""
+    for attempt, delay in enumerate(_RETRY_DELAYS_S, start=1):
+        if delay:
+            print(
+                f"bench attempt {attempt - 1} failed (backend unavailable); "
+                f"retrying in {delay}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+        try:
+            # Bounded: with the tunnel relay dead, the child hangs at
+            # interpreter start (sitecustomize retries the tunnel forever),
+            # and without a timeout this wrapper would never emit its
+            # structured failure line.
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, _INNER_ENV: "1"},
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = "benchmark subprocess timed out (TPU tunnel hung?)"
+            continue  # retryable: the tunnel may come back
+        sys.stderr.write(proc.stderr)
+        if proc.returncode == 0 and '"value"' in proc.stdout:
+            sys.stdout.write(proc.stdout)
+            return
+        last_err = (proc.stderr or "") + (proc.stdout or "")
+        if not _looks_retryable(last_err):
+            break  # deterministic failure: retrying would just burn time
+
+    # Final failure: one structured JSON line, not a traceback.
+    tail = "\n".join(last_err.strip().splitlines()[-5:])
+    print(
+        json.dumps(
+            {
+                "metric": _METRIC,
+                "value": None,
+                "unit": "tokens/sec/chip",
+                "vs_baseline": None,
+                "error": tail or "benchmark subprocess produced no output",
+            }
+        )
+    )
+    sys.exit(1)
 
 
 if __name__ == "__main__":

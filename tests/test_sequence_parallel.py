@@ -87,3 +87,136 @@ def test_ring_under_jit(seq_mesh, qkv):
         q, k, v, jnp.tril(jnp.ones((64, 64), bool))[None, None]
     )
     np.testing.assert_allclose(jitted(q, k, v), want, atol=1e-5)
+
+
+class TestSeqParallelTraining:
+    """Sequence parallelism as a *training path* (VERDICT round 1: ring/
+    Ulysses never reached the model or trainer): DistributedTrainer with
+    MeshConfig(seq>1) must train and match the single-device run."""
+
+    def _configs(self, attention_impl, decoder_only=False, seq_len=9):
+        from transformer_tpu.config import ModelConfig, TrainConfig
+
+        model = ModelConfig(
+            num_layers=2, d_model=16, num_heads=4, dff=32,
+            input_vocab_size=32, target_vocab_size=32, max_position=32,
+            dtype="float32", dropout_rate=0.0,
+            attention_impl=attention_impl, decoder_only=decoder_only,
+        )
+        tcfg = TrainConfig(
+            batch_size=8, sequence_length=seq_len, epochs=1, warmup_steps=10,
+            loss_normalization="tokens",
+        )
+        return model, tcfg
+
+    def _batches(self, n, seq_len=9):
+        out = []
+        for i in range(n):
+            ks, kt = jax.random.split(jax.random.PRNGKey(100 + i))
+            src = np.asarray(jax.random.randint(ks, (8, seq_len), 1, 32), np.int32)
+            tgt = np.asarray(jax.random.randint(kt, (8, seq_len), 1, 32), np.int32)
+            out.append((src, tgt))
+        return out
+
+    def _single_losses(self, model, tcfg, batches):
+        from transformer_tpu.train import create_train_state, make_train_step
+
+        state = create_train_state(jax.random.PRNGKey(0), model, tcfg)
+        step = jax.jit(make_train_step(model, tcfg))
+        rng = jax.random.PRNGKey(42)
+        losses = []
+        for src, tgt in batches:
+            state, m = step(state, src, tgt, rng)
+            losses.append(float(m["loss"]))
+        return losses
+
+    def _mesh_losses(self, model, tcfg, batches, mesh_cfg):
+        from transformer_tpu.parallel import (
+            create_sharded_state, make_mesh, make_sharded_steps, put_batch,
+        )
+
+        mesh = make_mesh(mesh_cfg)
+        state, shardings = create_sharded_state(
+            jax.random.PRNGKey(0), model, tcfg, mesh
+        )
+        train_step, _ = make_sharded_steps(
+            mesh, model, tcfg, shardings, shard_seq=True, donate=False
+        )
+        rng = jax.random.PRNGKey(42)
+        losses = []
+        for src, tgt in batches:
+            state, m = train_step(
+                state,
+                put_batch(src, mesh, shard_seq=True),
+                put_batch(tgt, mesh, shard_seq=True),
+                rng,
+            )
+            losses.append(float(m["loss"]))
+        return losses
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_seq2_matches_single_device(self, impl):
+        model, tcfg = self._configs(impl)
+        ref_model, _ = self._configs("xla")
+        batches = self._batches(3)
+        want = self._single_losses(ref_model, tcfg, batches)
+        got = self._mesh_losses(
+            model, tcfg, batches, MeshConfig(data=4, seq=2)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    def test_seq4_with_fsdp_matches_single_device(self):
+        model, tcfg = self._configs("ring")
+        ref_model, _ = self._configs("xla")
+        batches = self._batches(3)
+        want = self._single_losses(ref_model, tcfg, batches)
+        got = self._mesh_losses(
+            model, tcfg, batches, MeshConfig(data=1, fsdp=2, seq=4)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    def test_decoder_only_long_context_ring(self):
+        """The 4k-config shape (BASELINE configs[4]) scaled down: causal LM
+        training with the sequence split over the mesh — the multi-chip
+        long-context path SURVEY §5 demands."""
+        model, tcfg = self._configs("ring", decoder_only=True, seq_len=17)
+        ref_model, _ = self._configs("xla", decoder_only=True, seq_len=17)
+        batches = self._batches(3, seq_len=17)
+        want = self._single_losses(ref_model, tcfg, batches)
+        got = self._mesh_losses(
+            model, tcfg, batches, MeshConfig(data=1, seq=8)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    def test_distributed_trainer_seq_axis(self):
+        """End-to-end: DistributedTrainer(MeshConfig(seq=2)) fits."""
+        from transformer_tpu.parallel import DistributedTrainer, make_mesh
+
+        model, tcfg = self._configs("ring")
+        mesh = make_mesh(MeshConfig(data=4, seq=2))
+        batches = self._batches(2)
+
+        class DS:
+            def batches(self, epoch):
+                yield from batches
+
+        trainer = DistributedTrainer(model, tcfg, mesh, log_fn=lambda *_: None)
+        trainer.fit(DS())
+        assert int(jax.device_get(trainer.state.step)) == 2
+
+    def test_xla_impl_with_seq_axis_rejected(self):
+        from transformer_tpu.parallel import DistributedTrainer, make_mesh
+
+        model, tcfg = self._configs("xla")
+        mesh = make_mesh(MeshConfig(data=4, seq=2))
+        with pytest.raises(ValueError, match="sequence-parallel"):
+            DistributedTrainer(model, tcfg, mesh)
+
+    def test_ring_without_context_raises(self):
+        from transformer_tpu.models import transformer_apply, transformer_init
+
+        model, _ = self._configs("ring")
+        params = transformer_init(jax.random.PRNGKey(0), model)
+        ids = np.ones((2, 8), np.int32)
+        with pytest.raises(RuntimeError, match="sequence-parallel context"):
+            transformer_apply(params, ids, ids, model)

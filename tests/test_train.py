@@ -206,6 +206,92 @@ class TestGreedyDecode:
         assert len(single) == 1 and len(double) == 2
         assert all(isinstance(t, str) for t in double)
 
+    def test_translate_buckets_widths_one_compile(self):
+        """Varying source widths/batch sizes within one bucket must reuse one
+        compiled executable (the decode-side recompile bomb: reference decode
+        re-traces per shape, train.py:109-118; round-1 translate() recompiled
+        per source width)."""
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+        from transformer_tpu.train.decode import greedy_decode
+
+        tok = SubwordTokenizer.build_from_corpus(
+            ["ab cd ef gh ij"] * 3, target_vocab_size=270
+        )
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=tok.model_vocab_size,
+            target_vocab_size=tok.model_vocab_size,
+            max_position=32, dtype="float32", dropout_rate=0.0,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        before = greedy_decode._cache_size()
+        # Different sentence counts and raw token widths — all land in the
+        # (batch<=1-pow2, width<=16) bucket, so exactly one new compile.
+        translate(params, cfg, tok, tok, "ab", max_len=5)
+        translate(params, cfg, tok, tok, "ab cd ef", max_len=5)
+        translate(params, cfg, tok, tok, "ab cd ef gh ij", max_len=5)
+        assert greedy_decode._cache_size() == before + 1
+
+    def test_bucket_rounding(self):
+        from transformer_tpu.train.decode import _bucket
+
+        assert _bucket(3, 4096) == 16   # floor
+        assert _bucket(17, 4096) == 32  # next pow2
+        assert _bucket(100, 64) == 64   # capped
+        assert _bucket(5, 4096, floor=1) == 8
+
+    def test_translate_overlong_input_fails_loudly(self):
+        """A sentence longer than max_position must raise, not silently
+        truncate away its EOS (src_len= opts into explicit truncation)."""
+        import pytest
+
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+        tok = SubwordTokenizer.build_from_corpus(
+            ["ab cd ef gh"] * 3, target_vocab_size=270
+        )
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=tok.model_vocab_size,
+            target_vocab_size=tok.model_vocab_size,
+            max_position=8, dtype="float32", dropout_rate=0.0,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        long_sentence = "ab cd ef gh " * 8
+        with pytest.raises(ValueError, match="max_position"):
+            translate(params, cfg, tok, tok, long_sentence, max_len=4)
+        # Explicit src_len still allows truncation.
+        out = translate(params, cfg, tok, tok, long_sentence, max_len=4, src_len=8)
+        assert len(out) == 1
+
+
+class TestExportRoundTrip:
+    def test_export_load_identical_decode(self, tmp_path, monkeypatch):
+        """Export → load via the serving CLI path → decode output must be
+        identical to decoding with the in-memory params (the reference's
+        SavedModel capability, train.py:246, exercised end-to-end)."""
+        from transformer_tpu.cli.translate import load_export
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+        from transformer_tpu.train.checkpoint import export_params
+
+        tok = SubwordTokenizer.build_from_corpus(
+            ["ab cd ef gh"] * 3, target_vocab_size=270
+        )
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=tok.model_vocab_size,
+            target_vocab_size=tok.model_vocab_size,
+            max_position=32, dtype="float32", dropout_rate=0.0,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        export_params(params, cfg, str(tmp_path / "model"))
+
+        loaded_params, loaded_cfg = load_export(str(tmp_path / "model"))
+        assert loaded_cfg == cfg
+        want = translate(params, cfg, tok, tok, ["ab cd", "ef gh"], max_len=6)
+        got = translate(loaded_params, loaded_cfg, tok, tok, ["ab cd", "ef gh"], max_len=6)
+        assert want == got
+
 
 class TestTensorBoardWriter:
     def test_record_framing_and_crc(self, tmp_path):

@@ -47,7 +47,8 @@ def define_flags() -> None:
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
-    flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring"], "attention kernel")
+    flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring", "ulysses"],
+                      "attention kernel (ring/ulysses = sequence-parallel, use with --sp>1)")
     flags.DEFINE_string("dtype", "bfloat16", "compute dtype")
     flags.DEFINE_string("tb_log_dir", "logs", "TensorBoard log root")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
@@ -62,7 +63,18 @@ def define_flags() -> None:
     flags.DEFINE_integer("fsdp", 1, "fsdp (param-shard) mesh size")
     flags.DEFINE_integer("tp", 1, "tensor-parallel mesh size")
     flags.DEFINE_integer("sp", 1, "sequence-parallel mesh size")
-    flags.DEFINE_integer("pp", 1, "pipeline-parallel mesh size (GPipe stages)")
+    flags.DEFINE_integer(
+        "pp", 1,
+        "pipeline-parallel mesh size (GPipe stages). Note: pipe partitions "
+        "compute only; combine with --fsdp to shard stage params/optimizer "
+        "state, else each device holds a full param replica.")
+    flags.DEFINE_integer(
+        "pp_microbatches", 0,
+        "GPipe microbatches per step (0 = one per stage); more microbatches "
+        "shrink the pipeline bubble at the cost of smaller per-shard matmuls")
+    flags.DEFINE_integer(
+        "eval_max_batches", 8,
+        "cap on in-loop eval batches (0 = full test set each eval)")
 
 
 def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> ModelConfig:
@@ -98,6 +110,8 @@ def flags_to_train_config() -> TrainConfig:
         ckpt_path=FLAGS.ckpt_path,
         enable_function=FLAGS.enable_function,
         seed=FLAGS.seed,
+        pp_microbatches=FLAGS.pp_microbatches,
+        eval_max_batches=FLAGS.eval_max_batches,
     )
 
 

@@ -1,0 +1,84 @@
+"""Serving-side entry point: load an export and translate text.
+
+Exercises the counterpart of the reference's ``tf.saved_model.save`` output
+(``train.py:246``, README "Model Exporting"): the directory written by
+``export_params`` (params.npz + config.json) is loaded *without the training
+stack* and driven end-to-end — tokenize → greedy decode → detokenize.
+
+    python -m transformer_tpu.cli.translate --export_path=model \
+        --src_vocab_file=src_vocab.subwords --tgt_vocab_file=tgt_vocab.subwords \
+        [--sentences="he go to school"]            # or read stdin, one per line
+"""
+
+from __future__ import annotations
+
+import sys
+
+from absl import app, flags, logging
+
+FLAGS = flags.FLAGS
+
+
+def define_translate_flags() -> None:
+    flags.DEFINE_string("export_path", "model", "directory written by export_params")
+    flags.DEFINE_string("src_vocab_file", "src_vocab.subwords", "source subword vocab")
+    flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords", "target subword vocab")
+    flags.DEFINE_string("sentences", "", "';'-separated sentences (default: stdin lines)")
+    flags.DEFINE_integer("max_len", 64, "max generated tokens per sentence")
+    flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+
+
+def load_export(export_path: str):
+    """(params, model_cfg) from an export directory — no trainer needed."""
+    import os
+
+    import jax
+
+    from transformer_tpu.config import ModelConfig, config_from_json
+    from transformer_tpu.models import transformer_init
+    from transformer_tpu.train.checkpoint import load_exported_params
+
+    with open(os.path.join(export_path, "config.json")) as f:
+        model_cfg = config_from_json(ModelConfig, f.read())
+    # Template gives load_exported_params the tree structure + dtypes; its
+    # (random) values are fully overwritten by the stored arrays.
+    template = transformer_init(jax.random.PRNGKey(0), model_cfg)
+    params = load_exported_params(export_path, template)
+    return params, model_cfg
+
+
+def main(argv) -> None:
+    del argv
+    if FLAGS.platform:
+        import jax
+
+        jax.config.update("jax_platforms", FLAGS.platform)
+
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.train.decode import translate
+
+    params, model_cfg = load_export(FLAGS.export_path)
+    src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
+    tgt_tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+
+    if FLAGS.sentences:
+        sentences = [s.strip() for s in FLAGS.sentences.split(";") if s.strip()]
+    else:
+        sentences = [line.strip() for line in sys.stdin if line.strip()]
+    if not sentences:
+        logging.warning("no input sentences")
+        return
+    outputs = translate(
+        params, model_cfg, src_tok, tgt_tok, sentences, max_len=FLAGS.max_len
+    )
+    for out in outputs:
+        print(out)
+
+
+def run() -> None:
+    define_translate_flags()
+    app.run(main)
+
+
+if __name__ == "__main__":
+    run()

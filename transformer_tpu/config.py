@@ -55,7 +55,9 @@ class ModelConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     # Attention implementation: "xla" (einsum softmax einsum, XLA-fused),
-    # "flash" (Pallas blockwise kernel), "ring" (sequence-parallel ring over ICI).
+    # "flash" (Pallas blockwise kernel), "ring" (sequence-parallel ring over
+    # ICI), "ulysses" (sequence-parallel head all-to-all). ring/ulysses train
+    # through DistributedTrainer with MeshConfig(seq>1).
     attention_impl: str = "xla"
     # Block sizes for the Pallas flash-attention kernel.
     flash_block_q: int = 128
@@ -72,7 +74,7 @@ class ModelConfig:
             raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
         if self.ffn_activation not in ("relu", "gelu", "silu"):
             raise ValueError(f"unknown ffn_activation {self.ffn_activation!r}")
-        if self.attention_impl not in ("xla", "flash", "ring"):
+        if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
     @property
@@ -110,6 +112,11 @@ class TrainConfig:
     max_grad_norm: float = 0.0  # 0 disables clipping (reference has none)
     buffer_size: int = 100000  # shuffle buffer (reference ``utils.py:19``)
     eval_every_steps: int = 500
+    # In-loop eval batch cap: the reference either runs the FULL test set
+    # every 100 steps (``train.py:193-195``) or ~1 batch (``distributed_
+    # train.py:94``) — both defects (SURVEY §2.3.3/.6). Bounded and
+    # configurable here; 0 = no cap (full test set).
+    eval_max_batches: int = 8
     log_every_steps: int = 100
     checkpoint_every_epochs: int = 5  # intent of the reference's (buggy) save cond
     max_ckpt_keep: int = 5
@@ -138,7 +145,10 @@ class MeshConfig:
     - ``model``: tensor parallelism (attention heads / dff)
     - ``seq``: sequence/context parallelism (ring attention over ICI)
     - ``pipe``: pipeline parallelism (GPipe microbatch schedule, activations
-      ppermute between stages — ``parallel/pipeline.py``)
+      ppermute between stages — ``parallel/pipeline.py``). Memory note: the
+      pipe axis partitions *compute*; combine with ``fsdp`` to also shard
+      stage parameters/optimizer state, otherwise each device holds a full
+      replica of the stacked layer params.
     """
 
     data: int = 1

@@ -283,8 +283,10 @@ class NativeTokenizer:
         if not words:
             return []
         blob = "\n".join(words).encode("utf-8")
-        # Each output id consumes >=1 input byte, +1 word-end marker per word.
-        cap = len(blob) + len(words) + 8
+        # Escaping can expand input (e.g. '_' -> '\\u' emits up to 2
+        # byte-fallback ids per input byte), so size for 2 ids per escaped
+        # byte + 1 word-end marker per word; the retry below then never fires.
+        cap = 2 * len(blob) + len(words) + 8
         out = np.empty(cap, dtype=np.int32)
         n = self._lib.tpu_tok_encode(
             self._handle,

@@ -105,6 +105,21 @@ def project_kv(params: Params, x_kv: jax.Array, dtype=None) -> tuple[jax.Array, 
     return _project(params["key"], x_kv, dtype), _project(params["value"], x_kv, dtype)
 
 
+def _kv_padding_mask(mask: jax.Array | None, impl: str) -> jax.Array | None:
+    """Blockwise kernels (flash/ring/ulysses) take key-padding only: squeeze a
+    broadcastable (B|1, 1, 1, S_k) allowed-mask to (B|1, S_k), or reject."""
+    if mask is None:
+        return None
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[-2] == 1:
+        return mask[:, 0, 0, :]
+    raise ValueError(
+        f"attention_impl={impl!r} takes a key-padding mask (B, 1, 1, S_k) "
+        f"plus the structural causal flag; got a mask of shape {mask.shape}. "
+        "Per-head masks are unsupported, and causality must be passed as "
+        "causal=True, not folded into the mask."
+    )
+
+
 def mha_apply(
     params: Params,
     x_q: jax.Array,
@@ -169,18 +184,7 @@ def mha_apply(
         # kernel can skip above-diagonal tiles instead of masking them.
         from transformer_tpu.kernels.flash_attention import flash_attention
 
-        if mask is None:
-            kv_mask = None
-        elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[-2] == 1:
-            kv_mask = mask[:, 0, 0, :]  # (B|1, 1, 1, S_k) -> (B|1, S_k)
-        else:
-            raise ValueError(
-                "attention_impl='flash' takes a key-padding mask "
-                "(B, 1, 1, S_k) plus the structural causal flag; got a mask "
-                f"of shape {mask.shape}. Per-head masks are unsupported, and "
-                "causality must be passed as causal=True, not folded into "
-                "the mask."
-            )
+        kv_mask = _kv_padding_mask(mask, impl)
         out = flash_attention(
             q, k, v,
             kv_mask=kv_mask,
@@ -189,12 +193,31 @@ def mha_apply(
             block_k=flash_block_k,
         )
         weights = None
-    elif impl == "ring" and cache is None:
-        raise NotImplementedError(
-            "attention_impl='ring' is a stack-level sequence-parallel "
-            "transform; use transformer_tpu.parallel.ring_attention "
-            "inside shard_map (see parallel.make_sequence_parallel_attention)"
+    elif impl in ("ring", "ulysses") and cache is None:
+        # Stack-level sequence parallelism: the distributed engine activates a
+        # SeqParallelContext around the jitted forward
+        # (parallel/distributed.make_sharded_steps), and the attention core
+        # runs under shard_map on the context's mesh with S split over the
+        # 'seq' axis (KV chunks ride ICI via ppermute / all_to_all —
+        # parallel/ring_attention.py).
+        from transformer_tpu.parallel.seq_context import (
+            current_seq_context,
+            seq_parallel_attention,
         )
+
+        ctx = current_seq_context()
+        if ctx is None:
+            raise RuntimeError(
+                f"attention_impl={impl!r} needs an active sequence-parallel "
+                "context: train through DistributedTrainer with "
+                "MeshConfig(seq>1) (or wrap the forward in "
+                "parallel.seq_context.sequence_parallel)"
+            )
+        kv_mask = _kv_padding_mask(mask, impl)
+        if kv_mask is not None and kv_mask.shape[0] == 1 and q.shape[0] != 1:
+            kv_mask = jnp.broadcast_to(kv_mask, (q.shape[0], kv_mask.shape[1]))
+        out = seq_parallel_attention(ctx, impl, q, k, v, kv_mask, causal)
+        weights = None
     else:
         if causal and cache is None:
             # Causality is enforced whether or not a padding mask was provided.

@@ -59,6 +59,48 @@ def _pipelined_forward(
     return forward
 
 
+def _seq_parallel_forward(
+    mesh: Mesh, model_cfg: ModelConfig, base_forward: Callable | None
+) -> Callable:
+    """Forward wrapper for meshes with a ``seq`` axis and a sequence-parallel
+    attention impl ("ring"/"ulysses"): activates the SeqParallelContext so
+    every ``mha_apply`` traced inside runs its attention core under shard_map
+    with the sequence split over the ``seq`` axis (KV ring over ICI)."""
+    from transformer_tpu.config import PAD_ID
+    from transformer_tpu.parallel.seq_context import (
+        SeqParallelContext,
+        sequence_parallel,
+    )
+    from transformer_tpu.train.trainer import _default_forward
+
+    import jax.numpy as jnp
+
+    inner = base_forward or _default_forward(model_cfg)
+    ctx = SeqParallelContext(mesh=mesh)
+    sp = mesh.shape["seq"]
+
+    def pad_ids(ids):
+        # Ring/Ulysses need S % sp == 0, but teacher forcing feeds S-1 tokens
+        # (train/trainer._shift_targets). Trailing PAD positions are inert:
+        # masked out of attention by the padding mask, causally unable to
+        # influence earlier positions, and their logits are sliced off below.
+        if ids is None:
+            return None, 0
+        extra = (-ids.shape[1]) % sp
+        if extra:
+            ids = jnp.pad(ids, ((0, 0), (0, extra)), constant_values=PAD_ID)
+        return ids, extra
+
+    def forward(params, src, tar_inp, rng, deterministic):
+        src_p, _ = pad_ids(src)
+        tar_p, extra = pad_ids(tar_inp)
+        with sequence_parallel(ctx):
+            logits = inner(params, src_p, tar_p, rng, deterministic)
+        return logits[:, : logits.shape[1] - extra]
+
+    return forward
+
+
 def make_sharded_steps(
     mesh: Mesh,
     model_cfg: ModelConfig,
@@ -81,6 +123,11 @@ def make_sharded_steps(
         if mesh.shape.get("pipe", 1) > 1
         else None
     )
+    if (
+        mesh.shape.get("seq", 1) > 1
+        and model_cfg.attention_impl in ("ring", "ulysses")
+    ):
+        forward_fn = _seq_parallel_forward(mesh, model_cfg, forward_fn)
     train_step = jax.jit(
         make_train_step(model_cfg, train_cfg, forward_fn=forward_fn),
         in_shardings=(shardings, data_sh, data_sh, repl),
@@ -105,6 +152,16 @@ def put_batch(batch: np.ndarray, mesh: Mesh, shard_seq: bool = False) -> jax.Arr
     the role the reference's ``strategy.make_dataset_iterator`` played
     (``distributed_train.py:151-152``), without a per-replica iterator protocol.
     """
+    if shard_seq:
+        # Sequence sharding needs S divisible by the seq axis; trailing PAD
+        # columns are inert (masked out of attention and loss) and the
+        # seq-parallel forward re-pads/slices around teacher forcing anyway.
+        from transformer_tpu.config import PAD_ID
+
+        sp = mesh.shape["seq"]
+        extra = (-batch.shape[1]) % sp
+        if extra:
+            batch = np.pad(batch, ((0, 0), (0, extra)), constant_values=PAD_ID)
     sharding = NamedSharding(mesh, batch_spec(mesh, shard_seq))
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
@@ -160,6 +217,19 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     f"pp_microbatches {num_mb} must divide the per-data-shard "
                     f"batch {per_shard}"
+                )
+        if mesh.shape.get("seq", 1) > 1:
+            # A seq axis only helps if activations are actually split along
+            # the sequence; ring/ulysses then keeps attention split too
+            # (plain xla attention under GSPMD would all-gather the sequence).
+            shard_seq = True
+            if model_cfg.attention_impl not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"MeshConfig(seq={mesh.shape['seq']}) needs a sequence-"
+                    "parallel attention impl: set ModelConfig(attention_impl="
+                    "'ring') (or 'ulysses'); plain "
+                    f"{model_cfg.attention_impl!r} attention would all-gather "
+                    "the sequence and defeat the axis"
                 )
         rng = rng if rng is not None else jax.random.PRNGKey(train_cfg.seed)
         state, shardings = create_sharded_state(rng, model_cfg, train_cfg, mesh)

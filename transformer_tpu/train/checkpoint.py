@@ -2,15 +2,33 @@
 
 Counterpart of the reference's ``tf.train.Checkpoint`` +
 ``CheckpointManager(max_to_keep)`` + ``restore(...).expect_partial()``
-(``train.py:77-80,159-164``), as a self-contained array-tree format:
+(``train.py:77-80,159-164``), as a self-contained array-tree format.
+
+Two on-disk layouts, auto-selected per save:
+
+*Replicated* (single-host / unsharded state — the reference's scale):
 
     <dir>/ckpt_<step>/
         arrays.npz      flattened {path: array} of the state pytree
-        meta.json       step, tree structure digest, configs (optional)
+        meta.json       step + key list
 
-Multi-host: only process 0 writes (TPU pods are multi-process; the reference
-is single-host and has no notion of this). Writes are atomic
-(tmp dir + rename) so a preempted save never leaves a corrupt "latest".
+*Sharded* (any leaf distributed over >1 device): no full array is ever
+materialized on any host — the thing that makes >HBM models checkpointable
+at all (the same rationale as sharded init, ``parallel/distributed.py``).
+Each process writes only the device shards it can address (one replica of
+each), with the global slice bounds encoded in the entry name:
+
+    <dir>/ckpt_<step>/
+        shards_p00000.npz   {key@d0s:d0e,d1s:d1e,...: shard array} per process
+        meta.json           step, format tag, global shapes/dtypes
+
+Restore reassembles per-device arrays with
+``jax.make_array_from_single_device_arrays`` against the *target's* sharding,
+so the round trip is shard-file → device, never via a host-gathered copy.
+A shared filesystem across hosts is assumed (the standard TPU-pod setup).
+
+Writes are atomic (tmp dir + rename + per-process sentinel) so a preempted
+save never leaves a corrupt "latest".
 """
 
 from __future__ import annotations
@@ -34,6 +52,38 @@ def _flatten(tree: Any) -> dict[str, np.ndarray]:
         key = _SEP.join(_path_elem(p) for p in path)
         flat[key] = np.asarray(jax.device_get(leaf))
     return flat
+
+
+def _is_distributed(leaf: Any) -> bool:
+    """True for a jax.Array laid out across more than one device."""
+    return isinstance(leaf, jax.Array) and len(leaf.sharding.device_set) > 1
+
+
+def _bounds(index: tuple, shape: tuple[int, ...]) -> tuple[tuple[int, int], ...]:
+    """Resolve a shard's tuple-of-slices index to explicit (start, stop)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _entry_name(key: str, bounds: tuple[tuple[int, int], ...]) -> str:
+    return key + "@" + ",".join(f"{a}:{b}" for a, b in bounds)
+
+
+def _parse_entry(entry: str) -> tuple[str, tuple[tuple[int, int], ...]]:
+    key, sep, spec = entry.rpartition("@")
+    if not sep:
+        return entry, ()
+    if not spec:  # scalar leaf: "key@" with an empty bounds spec
+        return key, ()
+    bounds = tuple(
+        (int(a), int(b))
+        for a, b in (part.split(":") for part in spec.split(","))
+    )
+    return key, bounds
 
 
 def _path_elem(p) -> str:
@@ -66,6 +116,9 @@ class CheckpointManager:
     # ------------------------------------------------------------------ save
     def save(self, state: Any, step: int | None = None) -> str | None:
         step = int(state.step) if step is None else int(step)
+        leaves = jax.tree_util.tree_leaves(state)
+        if any(_is_distributed(l) for l in leaves):
+            return self._save_sharded(state, step)
         if not self.is_primary:
             return None
         final = os.path.join(self.directory, f"ckpt_{step:08d}")
@@ -81,6 +134,84 @@ class CheckpointManager:
             shutil.rmtree(final)
         os.replace(tmp, final)
         self._rotate()
+        return final
+
+    def _save_sharded(self, state: Any, step: int) -> str:
+        """Every process writes its addressable shards; no full-array gather.
+
+        Cross-process protocol: device-backed barriers
+        (``multihost_utils.sync_global_devices``), not filesystem handshakes —
+        stale marker files from a crashed previous save of the *same* step
+        cannot fake a phase transition. Phase 1: primary clears any stale
+        ``.tmp`` dir; barrier; phase 2: everyone writes its shard file;
+        barrier; phase 3: primary renames tmp → final. A dead peer fails the
+        barrier (backend timeout) loudly instead of committing a checkpoint
+        with missing shards. (Single-process: barriers are skipped.)
+        """
+        proc = jax.process_index()
+        nproc = jax.process_count()
+        final = os.path.join(self.directory, f"ckpt_{step:08d}")
+        tmp = final + ".tmp"
+
+        def barrier(tag: str) -> None:
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"ckpt_{step}_{tag}")
+
+        if self.is_primary:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp, exist_ok=True)
+        barrier("tmp_ready")
+
+        entries: dict[str, np.ndarray] = {}
+        meta_arrays: dict[str, dict] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+            key = _SEP.join(_path_elem(p) for p in path)
+            if _is_distributed(leaf):
+                shape = tuple(leaf.shape)
+                for shard in leaf.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue  # one copy of each distinct slice suffices
+                    b = _bounds(shard.index, shape)
+                    entries[_entry_name(key, b)] = np.asarray(shard.data)
+            else:
+                # Replicated / host-local leaf: one copy, written by primary.
+                shape = tuple(np.shape(leaf))
+                if self.is_primary:
+                    arr = np.asarray(jax.device_get(leaf))
+                    b = tuple((0, d) for d in shape)
+                    entries[_entry_name(key, b)] = arr
+            if self.is_primary:
+                meta_arrays[key] = {
+                    "shape": list(shape),
+                    "dtype": str(
+                        leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype
+                    ),
+                }
+        np.savez(os.path.join(tmp, f"shards_p{proc:05d}.npz"), **entries)
+        if self.is_primary:
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(
+                    {
+                        "step": step,
+                        "format": "sharded-v1",
+                        "n_processes": nproc,
+                        "arrays": meta_arrays,
+                    },
+                    f,
+                )
+        barrier("shards_written")
+        if self.is_primary:
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            self._rotate()
+        # No process may report the save durable before the rename commits —
+        # otherwise a peer could see "saved step N" for a checkpoint that a
+        # primary crash leaves uncommitted.
+        barrier("committed")
         return final
 
     def _rotate(self) -> None:
@@ -106,8 +237,20 @@ class CheckpointManager:
     # --------------------------------------------------------------- restore
     def restore(self, target: Any, step: int) -> Any:
         """Restore into the structure of ``target`` (arrays replaced by saved
-        values; shapes/dtypes validated). Returns a new pytree."""
-        path = os.path.join(self.directory, f"ckpt_{step:08d}", "arrays.npz")
+        values; shapes/dtypes validated). Returns a new pytree.
+
+        If the checkpoint is in the sharded format, ``target``'s leaves must
+        carry the shardings to restore into (e.g. the sharded-init state);
+        each device shard is loaded directly from the shard files.
+        """
+        ckpt_dir = os.path.join(self.directory, f"ckpt_{step:08d}")
+        meta_path = os.path.join(ckpt_dir, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("format") == "sharded-v1":
+                return self._restore_sharded(target, ckpt_dir, meta)
+        path = os.path.join(ckpt_dir, "arrays.npz")
         with np.load(path) as data:
             flat = {k: data[k] for k in data.files}
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
@@ -123,6 +266,98 @@ class CheckpointManager:
                     f"{key}: checkpoint shape {saved.shape} != target {leaf_arr.shape}"
                 )
             new_leaves.append(saved.astype(leaf_arr.dtype))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def _restore_sharded(self, target: Any, ckpt_dir: str, meta: dict) -> Any:
+        """Shard-file → device restore; never materializes a full array."""
+        shard_files = sorted(
+            os.path.join(ckpt_dir, n)
+            for n in os.listdir(ckpt_dir)
+            if n.startswith("shards_p") and n.endswith(".npz")
+        )
+        # Lazily-opened npz handles + a location index built from entry names
+        # (cheap: names only, no array data is read until requested).
+        handles = [np.load(f) for f in shard_files]
+        where: dict[tuple[str, tuple], int] = {}
+        for i, h in enumerate(handles):
+            for entry in h.files:
+                where[_parse_entry(entry)] = i
+
+        def read(key: str, bounds: tuple[tuple[int, int], ...]) -> np.ndarray:
+            i = where.get((key, bounds))
+            if i is not None:
+                return handles[i][_entry_name(key, bounds)]
+            # Bounds not stored verbatim (restore topology differs from save
+            # topology): stitch the requested window from overlapping stored
+            # chunks. Worst case this reads a leaf-sized window — still never
+            # the whole tree at once.
+            shape = tuple(b - a for a, b in bounds)
+            out = np.empty(shape, dtype=meta["arrays"][key]["dtype"])
+            filled = np.zeros(shape, dtype=bool)
+            for (k, b2), i2 in where.items():
+                if k != key:
+                    continue
+                inter = tuple(
+                    (max(a1, a2), min(e1, e2))
+                    for (a1, e1), (a2, e2) in zip(bounds, b2)
+                )
+                if any(a >= e for a, e in inter):
+                    continue
+                chunk = handles[i2][_entry_name(key, b2)]
+                src = tuple(
+                    slice(a - a2, e - a2)
+                    for (a, e), (a2, _) in zip(inter, b2)
+                )
+                dst = tuple(
+                    slice(a - a1, e - a1)
+                    for (a, e), (a1, _) in zip(inter, bounds)
+                )
+                out[dst] = chunk[src]
+                filled[dst] = True
+            if not filled.all():
+                raise KeyError(
+                    f"checkpoint shard files do not cover {key!r} {bounds}"
+                )
+            return out
+
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
+        new_leaves = []
+        try:
+            for p, leaf in leaves_with_path:
+                key = _SEP.join(_path_elem(e) for e in p)
+                if key not in meta["arrays"]:
+                    raise KeyError(f"checkpoint missing array {key!r}")
+                saved_shape = tuple(meta["arrays"][key]["shape"])
+                if isinstance(leaf, jax.Array) and saved_shape != tuple(leaf.shape):
+                    raise ValueError(
+                        f"{key}: checkpoint shape {saved_shape} != target "
+                        f"{tuple(leaf.shape)}"
+                    )
+                if _is_distributed(leaf):
+                    sharding = leaf.sharding
+                    dtype = leaf.dtype
+                    singles = [
+                        jax.device_put(
+                            read(key, _bounds(sharding.addressable_devices_indices_map(saved_shape)[d], saved_shape)).astype(dtype),
+                            d,
+                        )
+                        for d in sorted(
+                            sharding.addressable_devices, key=lambda d: d.id
+                        )
+                    ]
+                    new_leaves.append(
+                        jax.make_array_from_single_device_arrays(
+                            saved_shape, sharding, singles
+                        )
+                    )
+                else:
+                    full = tuple((0, d) for d in saved_shape)
+                    arr = read(key, full)
+                    leaf_arr = np.asarray(leaf)
+                    new_leaves.append(arr.astype(leaf_arr.dtype))
+        finally:
+            for h in handles:
+                h.close()
         return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def restore_latest(self, target: Any) -> Any | None:

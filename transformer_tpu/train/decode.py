@@ -67,6 +67,21 @@ def greedy_decode(
     return tokens.T  # (B, max_len)
 
 
+def _bucket(n: int, cap: int, floor: int = 16) -> int:
+    """Round ``n`` up to a power of two, clamped to [floor, cap].
+
+    Padding to buckets instead of exact sizes bounds the number of distinct
+    jit signatures at log2(cap) — without it every differently-shaped batch
+    of sentences pays a fresh XLA compile (the recompile-bomb class the
+    training pipeline already avoids, ``data/pipeline.py``; the reference's
+    concat-grow decode re-traces per step, ``train.py:109-118``).
+    """
+    w = floor
+    while w < n:
+        w *= 2
+    return min(w, cap)
+
+
 def translate(
     params,
     cfg: ModelConfig,
@@ -78,7 +93,12 @@ def translate(
 ) -> list[str]:
     """Text in, text out. Accepts a single string or a list (the reference's
     ``predict`` silently decodes one character when handed a bare str —
-    quirk §2.3.11; here both spellings work)."""
+    quirk §2.3.11; here both spellings work).
+
+    Source width and batch are padded up to power-of-two buckets (capped at
+    ``cfg.max_position``) so repeated calls with varying shapes reuse the
+    same compiled executable; ``src_len`` pins an exact width instead.
+    """
     if isinstance(sentences, str):
         sentences = [sentences]
     import numpy as np
@@ -87,10 +107,21 @@ def translate(
         [src_tokenizer.bos_id, *src_tokenizer.encode(s), src_tokenizer.eos_id]
         for s in sentences
     ]
-    width = src_len or max(len(e) for e in encoded)
-    src = np.full((len(encoded), width), PAD_ID, dtype=np.int32)
+    longest = max(len(e) for e in encoded)
+    if src_len is None and longest > cfg.max_position:
+        raise ValueError(
+            f"a sentence encodes to {longest} tokens but the model's "
+            f"max_position is {cfg.max_position}; shorten the input, or pass "
+            "src_len to truncate explicitly"
+        )
+    width = src_len or _bucket(longest, cfg.max_position)
+    n = len(encoded)
+    # Row bucket is pow2 with no cap (compile count stays logarithmic in the
+    # largest batch ever seen); pad rows are all-PAD and sliced off below.
+    rows = _bucket(n, 1 << 30, floor=1)
+    src = np.full((rows, width), PAD_ID, dtype=np.int32)
     for i, e in enumerate(encoded):
-        src[i, : len(e)] = e[:width]
+        src[i, : min(len(e), width)] = e[:width]
     out = jax.device_get(
         greedy_decode(
             params, jnp.asarray(src), cfg, max_len,
@@ -98,7 +129,7 @@ def translate(
         )
     )
     texts = []
-    for row in out:
+    for row in out[:n]:
         ids = [int(t) for t in row if t not in (PAD_ID, tgt_tokenizer.eos_id)]
         texts.append(tgt_tokenizer.decode(ids))
     return texts

@@ -273,7 +273,9 @@ class Trainer:
                         # stall, train.py:193-195, and 1-batch quirk §2.3.3).
                         self.step_timer.sync()
                         self.evaluate(
-                            test_ds.batches(epoch), max_batches=8, guard=guard
+                            test_ds.batches(epoch),
+                            max_batches=cfg.eval_max_batches or None,
+                            guard=guard,
                         )
                         self.log_fn(
                             f"  eval loss {self.eval_metrics.loss:.4f} "

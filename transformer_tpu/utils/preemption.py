@@ -16,6 +16,7 @@ data skew, which fingerprint comparison across runs/hosts catches).
 
 from __future__ import annotations
 
+import os
 import signal
 import zlib
 from typing import Any
@@ -45,6 +46,12 @@ class PreemptionGuard:
             prev = self._previous.get(signum)
             if callable(prev):
                 prev(signum, frame)
+            else:
+                # SIG_DFL/SIG_IGN are ints, not callables: restore the
+                # original disposition and re-deliver the signal so the
+                # default action (e.g. terminate, for SIGTERM) actually runs.
+                signal.signal(signum, prev if prev is not None else signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
             return
         self.should_stop = True
         self.signal_received = signum
