@@ -75,6 +75,12 @@ def define_flags() -> None:
     flags.DEFINE_integer(
         "eval_max_batches", 8,
         "cap on in-loop eval batches (0 = full test set each eval)")
+    flags.DEFINE_boolean(
+        "eval_bleu", True,
+        "compute corpus BLEU on the test split after training")
+    flags.DEFINE_integer(
+        "bleu_limit", 200,
+        "cap on test pairs scored for end-of-run BLEU (0 = all)")
 
 
 def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> ModelConfig:

@@ -194,15 +194,16 @@ class DistributedTrainer(Trainer):
         if n_stages > 1:
             unsupported = {
                 a: mesh.shape[a]
-                for a in ("fsdp", "model", "seq")
+                for a in ("model", "seq")
                 if mesh.shape.get(a, 1) > 1
             }
             if unsupported:
                 raise ValueError(
-                    f"pipe>1 composes only with the 'data' axis for now; got "
-                    f"{unsupported}. The GPipe schedule holds stage layers "
-                    "whole (parallel/pipeline.py), so fsdp/model/seq sharding "
-                    "inside stages is not wired through this path."
+                    f"pipe>1 composes with 'data' and 'fsdp' (stage params "
+                    "stay fsdp-sharded at rest and gather per layer — "
+                    f"parallel/pipeline.py), but not yet with {unsupported}: "
+                    "tensor/sequence sharding inside stages is not wired "
+                    "through the GPipe path."
                 )
             if model_cfg.num_layers % n_stages:
                 raise ValueError(

@@ -19,9 +19,13 @@ is net-new TPU-native machinery. Design:
 
 The schedule runs under ``shard_map``, so it composes with the ``data`` axis
 (batch-dim sharding splits the microbatches per data-parallel group and the
-schedule runs identically in each group). Tensor-sharding stage *interiors*
-over ``model``/``fsdp`` is not wired through this path — stages hold their
-layers whole.
+schedule runs identically in each group) and, via ``param_specs``, with
+``fsdp``: stage-interior layer parameters stay sharded over the fsdp axis at
+rest and are all-gathered **one layer at a time** inside the stage's layer
+scan (ZeRO-3 style), so no device ever holds more than one layer's full
+weights transiently — the pipe axis finally buys parameter-memory scaling
+when stacked with fsdp. Tensor-sharding interiors over ``model`` is not
+wired through this path.
 
 Everything is differentiable: ``ppermute``/``psum`` have transposes, so
 ``jax.grad`` through ``pipeline_apply`` yields exactly the backward schedule
@@ -51,6 +55,23 @@ def unstack_layer_params(stacked: Params, num_layers: int) -> list[Params]:
     return [jax.tree.map(lambda x: x[i], stacked) for i in range(num_layers)]
 
 
+def _gather_layer(lp: Params, specs: Params | None, fsdp_axis: str) -> Params:
+    """All-gather one layer's fsdp-sharded leaves to full arrays (ZeRO-3:
+    done per layer inside the stage scan, so only one layer's full weights
+    are ever live). ``specs`` carries each leaf's *unstacked* PartitionSpec;
+    None means everything is already replicated."""
+    if specs is None:
+        return lp
+
+    def gather(leaf, spec):
+        for d, ax in enumerate(spec):
+            if ax == fsdp_axis:
+                leaf = jax.lax.all_gather(leaf, fsdp_axis, axis=d, tiled=True)
+        return leaf
+
+    return jax.tree.map(gather, lp, specs, is_leaf=lambda x: x is None)
+
+
 def pipeline_apply(
     stacked_params: Params,
     layer_fn: Callable[..., jax.Array],
@@ -62,6 +83,8 @@ def pipeline_apply(
     base_rng: jax.Array | None = None,
     axis: str = "pipe",
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    param_specs: Params | None = None,
+    fsdp_axis: str = "fsdp",
 ) -> jax.Array:
     """Run a homogeneous layer stack over ``x`` with the GPipe schedule.
 
@@ -77,6 +100,10 @@ def pipeline_apply(
       base_rng: optional dropout seed; folded per (layer, microbatch) so the
         pipelined run matches a sequential run that folds the same way.
       batch_axes: mesh axes the batch dimension is sharded over.
+      param_specs: optional tree of *per-layer* PartitionSpecs (no leading
+        layer axis) whose ``fsdp_axis`` entries mark dims sharded over fsdp;
+        those leaves stay sharded at rest and are gathered per layer inside
+        the stage scan. None = stages hold their layers whole.
 
     Returns ``(B, ...)`` outputs, replicated over ``pipe``.
     """
@@ -87,7 +114,14 @@ def pipeline_apply(
             f"pipe axis size {n_stages} must divide num_layers {num_layers}"
         )
 
-    params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    if param_specs is None:
+        params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    else:
+        params_spec = jax.tree.map(
+            lambda spec: P(axis) if spec is None else P(axis, *spec),
+            param_specs,
+            is_leaf=lambda s: isinstance(s, P) or s is None,
+        )
     bspec = P(batch_axes)  # batch dim sharded, rest replicated
     consts_spec = tuple(P(batch_axes) for _ in mb_consts)
     rng_spec = P()
@@ -121,6 +155,7 @@ def pipeline_apply(
 
             def one_layer(h, xs):
                 local_i, lp = xs
+                lp = _gather_layer(lp, param_specs, fsdp_axis)
                 if base_rng is None:
                     r = None
                 else:
@@ -161,6 +196,22 @@ def pipeline_apply(
 # --------------------------------------------------------------------------
 # Model-level integration: pipelined encoder/decoder stacks + full forward.
 # --------------------------------------------------------------------------
+
+
+def _layer_fsdp_specs(layer_params: Params, mesh: Mesh) -> Params | None:
+    """Per-leaf PartitionSpecs for ONE layer's params, restricted to the fsdp
+    axis (the only interior sharding the GPipe path composes with): the same
+    path-suffix rules the rest layout uses (``parallel/sharding.py``), with
+    model/other axes dropped. None when the mesh has no fsdp axis."""
+    if mesh.shape.get("fsdp", 1) == 1:
+        return None
+    from transformer_tpu.parallel.sharding import param_partition_spec
+
+    def spec_for(path, leaf):
+        spec = param_partition_spec(path, leaf, mesh)
+        return P(*(ax if ax == "fsdp" else None for ax in spec))
+
+    return jax.tree_util.tree_map_with_path(spec_for, layer_params)
 
 
 def pipelined_transformer_apply(
@@ -209,6 +260,7 @@ def pipelined_transformer_apply(
         x = pipeline_apply(
             stacked, dec_layer, x, (self_mask,),
             mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
+            param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
         )
         if cfg.norm_scheme == "pre":
             x = layernorm_apply(
@@ -230,6 +282,7 @@ def pipelined_transformer_apply(
     enc_out = pipeline_apply(
         enc_stacked, enc_layer, x, (enc_mask,),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_enc,
+        param_specs=_layer_fsdp_specs(params["encoder"]["layers"][0], mesh),
     )
     if cfg.norm_scheme == "pre":
         enc_out = layernorm_apply(
@@ -249,6 +302,7 @@ def pipelined_transformer_apply(
     y = pipeline_apply(
         dec_stacked, dec_layer, y, (enc_out, self_mask, enc_mask),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
+        param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
     )
     if cfg.norm_scheme == "pre":
         y = layernorm_apply(

@@ -90,6 +90,7 @@ def translate(
     sentences: str | list[str],
     max_len: int = 64,
     src_len: int | None = None,
+    truncate: bool = False,
 ) -> list[str]:
     """Text in, text out. Accepts a single string or a list (the reference's
     ``predict`` silently decodes one character when handed a bare str —
@@ -108,11 +109,11 @@ def translate(
         for s in sentences
     ]
     longest = max(len(e) for e in encoded)
-    if src_len is None and longest > cfg.max_position:
+    if src_len is None and not truncate and longest > cfg.max_position:
         raise ValueError(
             f"a sentence encodes to {longest} tokens but the model's "
-            f"max_position is {cfg.max_position}; shorten the input, or pass "
-            "src_len to truncate explicitly"
+            f"max_position is {cfg.max_position}; shorten the input, or opt "
+            "into truncation (truncate=True / src_len=...)"
         )
     width = src_len or _bucket(longest, cfg.max_position)
     n = len(encoded)
@@ -121,7 +122,11 @@ def translate(
     rows = _bucket(n, 1 << 30, floor=1)
     src = np.full((rows, width), PAD_ID, dtype=np.int32)
     for i, e in enumerate(encoded):
-        src[i, : min(len(e), width)] = e[:width]
+        if len(e) > width:
+            # Truncation was opted into: keep the source well-formed by
+            # terminating the clipped sequence with EOS.
+            e = [*e[: width - 1], src_tokenizer.eos_id]
+        src[i, : len(e)] = e
     out = jax.device_get(
         greedy_decode(
             params, jnp.asarray(src), cfg, max_len,

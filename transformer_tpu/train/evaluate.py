@@ -1,0 +1,60 @@
+"""Model-quality evaluation: corpus BLEU over a parallel text file pair.
+
+The missing piece the reference never had (it reports token accuracy only,
+``train.py:140-141``) and the north-star metric of BASELINE.json ("eval BLEU
+on src/tgt"): greedy-decode every source sentence and score the detokenized
+hypotheses against the references with ``utils.bleu.corpus_bleu``.
+
+Used by the training CLI (end-of-run BLEU), ``cli.evaluate`` (score a saved
+export/checkpoint), and ``benchmarks/bleu_run.py`` (the convergence run that
+publishes the number in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.train.decode import translate
+from transformer_tpu.utils.bleu import corpus_bleu
+
+
+def bleu_on_pairs(
+    params,
+    model_cfg: ModelConfig,
+    src_tok,
+    tgt_tok,
+    src_lines: list[str],
+    ref_lines: list[str],
+    *,
+    batch_size: int = 64,
+    max_len: int = 64,
+    src_len: int | None = None,
+    log_fn: Callable[[str], None] | None = None,
+) -> tuple[float, list[str]]:
+    """(BLEU in [0,100], hypotheses). Decodes in fixed-size batches so the
+    bucketed ``translate`` path compiles once per (batch, width) bucket."""
+    if len(src_lines) != len(ref_lines):
+        raise ValueError(
+            f"src/ref line counts differ: {len(src_lines)} != {len(ref_lines)}"
+        )
+    hyps: list[str] = []
+    for start in range(0, len(src_lines), batch_size):
+        chunk = src_lines[start : start + batch_size]
+        hyps.extend(
+            translate(
+                params, model_cfg, src_tok, tgt_tok, chunk,
+                max_len=max_len, src_len=src_len,
+                # Corpus eval must not crash on over-long sentences: clip to
+                # the positional table (EOS-terminated), as standard eval does.
+                truncate=True,
+            )
+        )
+        if log_fn is not None and start // batch_size % 4 == 0:
+            log_fn(f"bleu eval: {start + len(chunk)}/{len(src_lines)} decoded")
+    return corpus_bleu(ref_lines, hyps), hyps
+
+
+def read_lines(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        return [line.rstrip("\n") for line in f]
