@@ -1,0 +1,107 @@
+"""Worker for the real multi-process test (tests/test_multiprocess.py).
+
+Launched twice (process_id 0 and 1) with a shared coordinator address; each
+process owns 4 virtual CPU devices, so the global mesh has 8. Exercises the
+code paths that single-process tests cannot: ``jax.distributed.initialize``
+bring-up, ``make_array_from_process_local_data`` batch assembly from
+process-local shards, cross-process collectives in the sharded train step,
+and the multi-process sharded-checkpoint barrier protocol.
+
+Prints one JSON line with per-step losses and a restore checksum.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def main() -> None:
+    coordinator, pid, workdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    from transformer_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=coordinator, num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+
+    from transformer_tpu.config import MeshConfig, ModelConfig, TrainConfig
+    from transformer_tpu.parallel import (
+        create_sharded_state,
+        make_mesh,
+        make_sharded_steps,
+        put_batch,
+    )
+    from transformer_tpu.train import CheckpointManager
+    from transformer_tpu.utils.preemption import tree_checksum
+
+    model_cfg = ModelConfig(
+        num_layers=2, d_model=16, num_heads=4, dff=32,
+        input_vocab_size=32, target_vocab_size=32, max_position=32,
+        dtype="float32", dropout_rate=0.0,
+    )
+    train_cfg = TrainConfig(
+        batch_size=16, sequence_length=8, warmup_steps=10,
+        loss_normalization="tokens",
+    )
+    mesh = make_mesh(MeshConfig(data=4, fsdp=2))
+    state, shardings = create_sharded_state(
+        jax.random.PRNGKey(0), model_cfg, train_cfg, mesh
+    )
+    train_step, _ = make_sharded_steps(
+        mesh, model_cfg, train_cfg, shardings, donate=False
+    )
+
+    rng = jax.random.PRNGKey(42)
+    losses = []
+    for i in range(3):
+        # Same GLOBAL batch on both processes; each feeds only its row shard
+        # (the multi-host data contract, Seq2SeqDataset.shard_index).
+        ks, kt = jax.random.split(jax.random.PRNGKey(100 + i))
+        src = np.asarray(jax.random.randint(ks, (16, 8), 1, 32), np.int32)
+        tgt = np.asarray(jax.random.randint(kt, (16, 8), 1, 32), np.int32)
+        lo, hi = pid * 8, (pid + 1) * 8
+        state, m = train_step(
+            state,
+            put_batch(src[lo:hi], mesh),
+            put_batch(tgt[lo:hi], mesh),
+            rng,
+        )
+        losses.append(float(m["loss"]))
+
+    # Multi-process sharded checkpoint: every process writes its addressable
+    # shards; device-backed barriers order clear -> write -> rename.
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), max_to_keep=2)
+    mgr.save(state, step=3)
+    restored = mgr.restore(state, step=3)
+    checksum = tree_checksum(jax.device_get(restored.params))
+
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "losses": [round(l, 6) for l in losses],
+                "restore_checksum": checksum,
+                "n_processes": jax.process_count(),
+                "n_devices": len(jax.devices()),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

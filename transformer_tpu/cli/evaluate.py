@@ -25,6 +25,7 @@ def define_evaluate_flags() -> None:
     flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords", "target subword vocab")
     flags.DEFINE_integer("batch_size", 64, "decode batch size")
     flags.DEFINE_integer("max_len", 64, "max generated tokens per sentence")
+    flags.DEFINE_integer("beam", 1, "beam size (1 = greedy)")
     flags.DEFINE_integer("limit", 0, "evaluate only the first N pairs (0 = all)")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
 
@@ -51,10 +52,11 @@ def main(argv) -> None:
     bleu, _ = bleu_on_pairs(
         params, model_cfg, src_tok, tgt_tok, src_lines, ref_lines,
         batch_size=FLAGS.batch_size, max_len=FLAGS.max_len,
+        beam_size=FLAGS.beam,
         log_fn=logging.info,
     )
-    logging.info("BLEU %.2f on %d pairs", bleu, len(src_lines))
-    print(json.dumps({"bleu": round(bleu, 2), "n": len(src_lines)}))
+    logging.info("BLEU %.2f on %d pairs (beam %d)", bleu, len(src_lines), FLAGS.beam)
+    print(json.dumps({"bleu": round(bleu, 2), "n": len(src_lines), "beam": FLAGS.beam}))
 
 
 def run() -> None:

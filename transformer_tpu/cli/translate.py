@@ -25,6 +25,7 @@ def define_translate_flags() -> None:
     flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords", "target subword vocab")
     flags.DEFINE_string("sentences", "", "';'-separated sentences (default: stdin lines)")
     flags.DEFINE_integer("max_len", 64, "max generated tokens per sentence")
+    flags.DEFINE_integer("beam", 1, "beam size (1 = greedy)")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
 
 
@@ -69,7 +70,8 @@ def main(argv) -> None:
         logging.warning("no input sentences")
         return
     outputs = translate(
-        params, model_cfg, src_tok, tgt_tok, sentences, max_len=FLAGS.max_len
+        params, model_cfg, src_tok, tgt_tok, sentences,
+        max_len=FLAGS.max_len, beam_size=FLAGS.beam,
     )
     for out in outputs:
         print(out)

@@ -67,6 +67,110 @@ def greedy_decode(
     return tokens.T  # (B, max_len)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_len", "bos_id", "eos_id", "beam_size", "alpha"),
+)
+def beam_search_decode(
+    params,
+    src_ids: jax.Array,
+    cfg: ModelConfig,
+    max_len: int,
+    bos_id: int,
+    eos_id: int,
+    beam_size: int = 4,
+    alpha: float = 0.6,
+) -> jax.Array:
+    """(B, S_src) source ids -> (B, max_len) ids of the best beam.
+
+    Capability beyond the reference (greedy only, ``train.py:112``). TPU-shaped
+    throughout: static beam width, one compiled program — beams ride the batch
+    dimension (B·K) through the same KV-cached decode step greedy uses, a
+    ``lax.scan`` advances all beams one token per tick, and beam reordering is
+    a batched gather of cache rows. Finished beams are frozen by forcing PAD
+    with probability one. Scores use GNMT length normalization
+    ``log p / ((5+len)/6)^alpha`` applied at selection time.
+    """
+    batch = src_ids.shape[0]
+    K = beam_size
+    vocab = cfg.target_vocab_size
+    NEG = jnp.float32(-1e9)
+
+    enc_mask = make_padding_mask(src_ids)
+    enc_out, _ = encoder_apply(params["encoder"], src_ids, enc_mask, cfg)
+    # Beams ride the batch dim: replicate encoder state K times -> (B*K, ...).
+    expand = lambda x: jnp.repeat(x, K, axis=0)  # noqa: E731
+    enc_out_k = expand(enc_out)
+    enc_mask_k = expand(enc_mask)
+    caches = init_decoder_caches(cfg, batch * K, max_len + 1)
+    cross_kvs = [
+        (expand(k), expand(v))
+        for k, v in precompute_cross_kvs(params["decoder"], enc_out, cfg)
+    ]
+
+    def step(carry, t):
+        tok, caches, scores, finished, tokens_buf = carry
+        # tok: (B*K, 1); scores/finished: (B, K); tokens_buf: (B, K, max_len)
+        logits, caches = transformer_decode_step(
+            params, tok, enc_out_k, enc_mask_k, caches, t, cfg,
+            cross_kvs=cross_kvs,
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(batch, K, vocab)
+        # Frozen beams: only PAD continues, at zero cost.
+        pad_only = jnp.full((vocab,), NEG).at[PAD_ID].set(0.0)
+        logp = jnp.where(finished[:, :, None], pad_only[None, None, :], logp)
+        # First tick: all K beams are identical — keep only beam 0's
+        # candidates or top-k would pick K copies of the same token.
+        live = jnp.where(
+            (t == 0) & (jnp.arange(K) > 0), NEG, 0.0
+        )[None, :, None]
+        combined = scores[:, :, None] + logp + live  # (B, K, V)
+        flat_scores, flat_idx = jax.lax.top_k(
+            combined.reshape(batch, K * vocab), K
+        )
+        parent = flat_idx // vocab  # (B, K)
+        nxt_tok = (flat_idx % vocab).astype(jnp.int32)
+
+        # Reorder per-batch state by parent beam (batched row gather).
+        row = (jnp.arange(batch)[:, None] * K + parent).reshape(-1)  # (B*K,)
+        caches = jax.tree.map(
+            lambda c: c[row] if c.ndim >= 1 and c.shape[0] == batch * K else c,
+            caches,
+        )
+        tokens_buf = jnp.take_along_axis(
+            tokens_buf, parent[:, :, None], axis=1
+        )
+        tokens_buf = jax.lax.dynamic_update_index_in_dim(
+            tokens_buf, nxt_tok, t, axis=2
+        )
+        finished = jnp.take_along_axis(finished, parent, axis=1)
+        new_finished = jnp.logical_or(finished, nxt_tok == eos_id)
+        emit = jnp.where(finished, PAD_ID, nxt_tok)  # pad after freeze
+        tok = emit.reshape(batch * K, 1)
+        return (tok, caches, flat_scores, new_finished, tokens_buf), None
+
+    init = (
+        jnp.full((batch * K, 1), bos_id, jnp.int32),
+        caches,
+        jnp.zeros((batch, K), jnp.float32),
+        jnp.zeros((batch, K), jnp.bool_),
+        jnp.full((batch, K, max_len), PAD_ID, jnp.int32),
+    )
+    (tok, caches, scores, finished, tokens_buf), _ = jax.lax.scan(
+        step, init, jnp.arange(max_len, dtype=jnp.int32)
+    )
+    # Length-normalized selection: len = tokens up to and incl. EOS (finished)
+    # or max_len (unfinished).
+    lengths = jnp.sum(tokens_buf != PAD_ID, axis=-1).astype(jnp.float32)
+    lengths = jnp.maximum(lengths, 1.0)
+    norm = ((5.0 + lengths) / 6.0) ** alpha
+    best = jnp.argmax(scores / norm, axis=1)  # (B,)
+    return jnp.take_along_axis(
+        tokens_buf, best[:, None, None], axis=1
+    )[:, 0, :]
+
+
 def _bucket(n: int, cap: int, floor: int = 16) -> int:
     """Round ``n`` up to a power of two, clamped to [floor, cap].
 
@@ -91,6 +195,8 @@ def translate(
     max_len: int = 64,
     src_len: int | None = None,
     truncate: bool = False,
+    beam_size: int = 1,
+    alpha: float = 0.6,
 ) -> list[str]:
     """Text in, text out. Accepts a single string or a list (the reference's
     ``predict`` silently decodes one character when handed a bare str —
@@ -99,6 +205,8 @@ def translate(
     Source width and batch are padded up to power-of-two buckets (capped at
     ``cfg.max_position``) so repeated calls with varying shapes reuse the
     same compiled executable; ``src_len`` pins an exact width instead.
+    ``beam_size > 1`` switches from greedy to beam search (GNMT length
+    penalty ``alpha``).
     """
     if isinstance(sentences, str):
         sentences = [sentences]
@@ -127,12 +235,21 @@ def translate(
             # terminating the clipped sequence with EOS.
             e = [*e[: width - 1], src_tokenizer.eos_id]
         src[i, : len(e)] = e
-    out = jax.device_get(
-        greedy_decode(
-            params, jnp.asarray(src), cfg, max_len,
-            tgt_tokenizer.bos_id, tgt_tokenizer.eos_id,
+    if beam_size > 1:
+        out = jax.device_get(
+            beam_search_decode(
+                params, jnp.asarray(src), cfg, max_len,
+                tgt_tokenizer.bos_id, tgt_tokenizer.eos_id,
+                beam_size=beam_size, alpha=alpha,
+            )
         )
-    )
+    else:
+        out = jax.device_get(
+            greedy_decode(
+                params, jnp.asarray(src), cfg, max_len,
+                tgt_tokenizer.bos_id, tgt_tokenizer.eos_id,
+            )
+        )
     texts = []
     for row in out[:n]:
         ids = [int(t) for t in row if t not in (PAD_ID, tgt_tokenizer.eos_id)]

@@ -30,6 +30,7 @@ def bleu_on_pairs(
     batch_size: int = 64,
     max_len: int = 64,
     src_len: int | None = None,
+    beam_size: int = 1,
     log_fn: Callable[[str], None] | None = None,
 ) -> tuple[float, list[str]]:
     """(BLEU in [0,100], hypotheses). Decodes in fixed-size batches so the
@@ -44,7 +45,7 @@ def bleu_on_pairs(
         hyps.extend(
             translate(
                 params, model_cfg, src_tok, tgt_tok, chunk,
-                max_len=max_len, src_len=src_len,
+                max_len=max_len, src_len=src_len, beam_size=beam_size,
                 # Corpus eval must not crash on over-long sentences: clip to
                 # the positional table (EOS-terminated), as standard eval does.
                 truncate=True,
