@@ -87,19 +87,38 @@ def _configs():
     return out
 
 
-def bench_config(name: str, n_steps: int = 20) -> dict:
+def bench_config(
+    name: str, n_steps: int = 20, mode: str = "full", profile_dir: str = ""
+) -> dict:
+    """One measurement. ``mode`` attributes step time without trace tooling:
+
+    - full:       the real train step (forward + backward + Adam)
+    - fwd:        eval step only — isolates the backward+optimizer share
+    - smallvocab: train step with a 2k-row OUTPUT vocab (input embedding
+                  untouched) — isolates the vocab-projection/CE share
+                  (32k-vocab logits matmul is the prime MFU suspect at seq 64)
+    """
+    import dataclasses
+
     import jax
     import numpy as np
 
-    from transformer_tpu.train import create_train_state, make_train_step
+    from transformer_tpu.train import (
+        create_train_state,
+        make_eval_step,
+        make_train_step,
+    )
 
     model_cfg, train_cfg, batch, seq = _configs()[name]
+    if mode == "smallvocab":
+        model_cfg = dataclasses.replace(model_cfg, target_vocab_size=2048)
     dev = jax.devices()[0]
     state = create_train_state(jax.random.PRNGKey(0), model_cfg, train_cfg)
     rng = jax.random.PRNGKey(1)
     r = np.random.default_rng(0)
-    src = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
-    tgt = jax.device_put(r.integers(1, 32000, (batch, seq), dtype=np.int32))
+    top = min(32000, model_cfg.target_vocab_size - 2)
+    src = jax.device_put(r.integers(1, top, (batch, seq), dtype=np.int32))
+    tgt = jax.device_put(r.integers(1, top, (batch, seq), dtype=np.int32))
 
     # Donated-state step except for tied-weight configs: donation aliases one
     # buffer into two consumers there, which the TPU backend rejects at
@@ -107,10 +126,14 @@ def bench_config(name: str, n_steps: int = 20) -> dict:
     # claim lease (see .claude/skills/verify/SKILL.md), so decide statically
     # rather than probing by running a doomed step.
     donate = not (model_cfg.tie_embeddings or model_cfg.tie_output)
-    step = jax.jit(
-        make_train_step(model_cfg, train_cfg),
-        donate_argnums=(0,) if donate else (),
-    )
+    if mode == "fwd":
+        eval_step = jax.jit(make_eval_step(model_cfg, train_cfg))
+        step = lambda state, src, tgt, rng: (state, eval_step(state, src, tgt))  # noqa: E731
+    else:
+        step = jax.jit(
+            make_train_step(model_cfg, train_cfg),
+            donate_argnums=(0,) if donate else (),
+        )
     if not donate:
         print(f"{name}: tied weights, benchmarking undonated", file=sys.stderr)
 
@@ -121,18 +144,25 @@ def bench_config(name: str, n_steps: int = 20) -> dict:
     # execution finishes, inflating throughput ~10x. float() cannot lie.
     float(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, src, tgt, rng)
-    final_loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    ctx = (
+        jax.profiler.trace(profile_dir) if profile_dir else contextlib.nullcontext()
+    )
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, src, tgt, rng)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
     assert final_loss == final_loss, "NaN loss"  # keep the fetch load-bearing
 
     tokens_per_step = batch * (seq - 1)
     value = tokens_per_step * n_steps / dt
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
     return {
-        "metric": f"{name} train throughput",
+        "metric": f"{name} train throughput"
+        + (f" [{mode}]" if mode != "full" else ""),
         "value": round(value, 1),
         "unit": "tokens/sec/chip",
         "config": {
@@ -158,29 +188,48 @@ def main() -> None:
         "--configs", default="tiny,base,big,tied,long4k",
         help="comma-separated subset",
     )
+    ap.add_argument(
+        "--modes", default="full",
+        help="comma-separated subset of full,fwd,smallvocab (time attribution)",
+    )
+    ap.add_argument(
+        "--profile_dir", default="",
+        help="capture a jax.profiler trace of the timing loop into this dir",
+    )
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
 
-    if len(names) > 1:
-        # One subprocess per config: a backend error (e.g. a rejected donated
-        # execution) can poison the TPU client for the rest of the process.
+    if len(names) * len(modes) > 1:
+        # One subprocess per measurement: a backend error (e.g. a rejected
+        # donated execution) can poison the TPU client for the process.
         import subprocess
 
         for name in names:
-            subprocess.run(
-                [sys.executable, __file__, "--steps", str(args.steps),
-                 "--configs", name],
-                check=False,
-            )
+            for mode in modes:
+                subprocess.run(
+                    [sys.executable, __file__, "--steps", str(args.steps),
+                     "--configs", name, "--modes", mode,
+                     "--profile_dir", args.profile_dir],
+                    check=False,
+                )
         return
 
-    name = names[0]
-    print(f"benchmarking {name}...", file=sys.stderr)
+    name, mode = names[0], modes[0]
+    print(f"benchmarking {name} [{mode}]...", file=sys.stderr)
     try:
-        print(json.dumps(bench_config(name, args.steps)), flush=True)
-    except Exception as e:  # record the failure as a JSON line
         print(
-            json.dumps({"metric": f"{name} train throughput", "error": str(e)}),
+            json.dumps(
+                bench_config(name, args.steps, mode, args.profile_dir)
+            ),
+            flush=True,
+        )
+    except Exception as e:  # record the failure as a JSON line
+        tag = f" [{mode}]" if mode != "full" else ""
+        print(
+            json.dumps(
+                {"metric": f"{name} train throughput{tag}", "error": str(e)}
+            ),
             flush=True,
         )
 

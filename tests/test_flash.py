@@ -221,3 +221,25 @@ class TestModelIntegration:
         want, _ = transformer_apply(params, None, tgt, cfg_xla)
         got, _ = transformer_apply(params, None, tgt, cfg_flash)
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_flash_with_remat_grads(self, rng):
+        """The long-context combination (flash kernel + cfg.remat): the
+        custom-vjp kernel under jax.checkpoint must still produce gradients
+        matching the plain xla model."""
+        cfg_xla, cfg_flash = self._cfgs()
+        cfg_fr = dataclasses.replace(
+            cfg_flash, decoder_only=True, remat=True
+        )
+        cfg_ref = dataclasses.replace(cfg_xla, decoder_only=True)
+        params = transformer_init(jax.random.PRNGKey(1), cfg_ref)
+        _, tgt = self._batch(rng)
+
+        def loss(p, cfg):
+            logits, _ = transformer_apply(p, None, tgt, cfg)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        g_ref = jax.jit(lambda p: jax.grad(loss)(p, cfg_ref))(params)
+        g_fr = jax.jit(lambda p: jax.grad(loss)(p, cfg_fr))(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g_ref, g_fr
+        )

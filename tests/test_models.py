@@ -135,6 +135,33 @@ class TestTransformer:
         l2 = fwd(params, inp, tar)
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
 
+    def test_remat_matches_plain(self):
+        """cfg.remat must change memory behavior only: forward logits and
+        gradients identical to the non-remat model."""
+        import dataclasses
+
+        from transformer_tpu.config import TrainConfig
+        from transformer_tpu.train import create_train_state, make_train_step
+
+        cfg_plain = dataclasses.replace(TINY, dropout_rate=0.0)
+        cfg_remat = dataclasses.replace(cfg_plain, remat=True)
+        tcfg = TrainConfig(batch_size=2, sequence_length=8, warmup_steps=10)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 7))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 7))
+
+        l_plain, _ = transformer_apply(transformer_init(jax.random.PRNGKey(0), cfg_plain), inp, tar, cfg_plain)
+        l_remat, _ = transformer_apply(transformer_init(jax.random.PRNGKey(0), cfg_remat), inp, tar, cfg_remat)
+        np.testing.assert_allclose(np.asarray(l_plain), np.asarray(l_remat), atol=1e-6)
+
+        rng = jax.random.PRNGKey(3)
+        s_plain = create_train_state(jax.random.PRNGKey(0), cfg_plain, tcfg)
+        s_remat = create_train_state(jax.random.PRNGKey(0), cfg_remat, tcfg)
+        _, m_plain = jax.jit(make_train_step(cfg_plain, tcfg))(s_plain, inp, tar, rng)
+        _, m_remat = jax.jit(make_train_step(cfg_remat, tcfg))(s_remat, inp, tar, rng)
+        np.testing.assert_allclose(
+            float(m_plain["loss"]), float(m_remat["loss"]), rtol=1e-6
+        )
+
     def test_tied_embeddings_share_table(self):
         cfg = ModelConfig(
             num_layers=1, d_model=16, num_heads=2, dff=32,

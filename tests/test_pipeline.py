@@ -201,6 +201,24 @@ class TestPipelinedTransformer:
         assert np.isfinite(float(metrics["loss"]))
         assert int(jax.device_get(new_state.step)) == 1
 
+    def test_remat_pipelined_matches_plain(self):
+        """cfg.remat must apply under the GPipe path too (memory-only lever:
+        identical logits)."""
+        import dataclasses
+
+        mesh = _mesh(1, 4)
+        cfg_r = dataclasses.replace(CFG, remat=True)
+        params = transformer_init(jax.random.PRNGKey(0), CFG)
+        inp = _ids(jax.random.PRNGKey(1), 8, 12)
+        tar = _ids(jax.random.PRNGKey(2), 8, 10)
+        want, _ = transformer_apply(params, inp, tar, CFG, None, True)
+        out = jax.jit(
+            lambda p: pipelined_transformer_apply(
+                p, inp, tar, cfg_r, mesh=mesh, num_microbatches=4
+            )
+        )(params)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-4)
+
     def test_combined_data_fsdp_pipe_grads(self):
         """data×fsdp×pipe (VERDICT round 1: pipe composed with nothing but
         data): stage params stay fsdp-sharded at rest, gathered per layer

@@ -50,6 +50,10 @@ def define_flags() -> None:
     flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring", "ulysses"],
                       "attention kernel (ring/ulysses = sequence-parallel, use with --sp>1)")
     flags.DEFINE_string("dtype", "bfloat16", "compute dtype")
+    flags.DEFINE_boolean(
+        "remat", False,
+        "rematerialize layer activations in backward (less HBM, ~1/3 more "
+        "FLOPs) — the long-context memory lever")
     flags.DEFINE_string("tb_log_dir", "logs", "TensorBoard log root")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
@@ -99,6 +103,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         ffn_activation="relu",
         dtype=FLAGS.dtype,
         attention_impl=FLAGS.attention_impl,
+        remat=FLAGS.remat,
     )
 
 

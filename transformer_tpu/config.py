@@ -62,6 +62,10 @@ class ModelConfig:
     # Block sizes for the Pallas flash-attention kernel.
     flash_block_q: int = 128
     flash_block_k: int = 128
+    # Rematerialize each layer's activations in the backward pass
+    # (jax.checkpoint): trades ~1/3 more FLOPs for O(layers) less activation
+    # HBM — the standard lever for long-context configs (BASELINE configs[4]).
+    remat: bool = False
 
     def __post_init__(self) -> None:
         if self.d_model % self.num_heads != 0:

@@ -147,12 +147,22 @@ def decoder_apply(
     )
     attn_weights: dict[str, jax.Array] = {}
     new_caches: list[dict[str, Any]] | None = [] if caches is not None else None
-    for i, layer in enumerate(params["layers"]):
-        x, w1, w2, new_cache = decoder_layer_apply(
+
+    def layer_call(layer, x, enc_out, self_mask, cross_mask, r, cache, cross_kv):
+        return decoder_layer_apply(
             layer, x, enc_out, self_mask, cross_mask, cfg,
-            rngs[i + 1], deterministic, return_weights,
-            cache=None if caches is None else caches[i],
-            cross_kv=None if cross_kvs is None else cross_kvs[i],
+            r, deterministic, return_weights, cache=cache, cross_kv=cross_kv,
+        )
+
+    if cfg.remat and caches is None:
+        # Training-time only (decode's KV-cache path gains nothing from
+        # recomputation); see cfg.remat docstring.
+        layer_call = jax.checkpoint(layer_call)
+    for i, layer in enumerate(params["layers"]):
+        x, w1, w2, new_cache = layer_call(
+            layer, x, enc_out, self_mask, cross_mask, rngs[i + 1],
+            None if caches is None else caches[i],
+            None if cross_kvs is None else cross_kvs[i],
         )
         if w1 is not None:
             attn_weights[f"decoder_layer{i + 1}_block1"] = w1

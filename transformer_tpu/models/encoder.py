@@ -139,10 +139,18 @@ def encoder_apply(
     )
     x = embed_prologue(params["embedding"], ids, cfg, rngs[0], deterministic)
     attn_weights: dict[str, jax.Array] = {}
-    for i, layer in enumerate(params["layers"]):
-        x, w = encoder_layer_apply(
-            layer, x, mask, cfg, rngs[i + 1], deterministic, return_weights
+
+    def layer_call(layer, x, mask, r):
+        return encoder_layer_apply(
+            layer, x, mask, cfg, r, deterministic, return_weights
         )
+
+    if cfg.remat:
+        # Long-context lever: recompute each layer's activations in the
+        # backward pass instead of keeping them live (cfg.remat docstring).
+        layer_call = jax.checkpoint(layer_call)
+    for i, layer in enumerate(params["layers"]):
+        x, w = layer_call(layer, x, mask, rngs[i + 1])
         if w is not None:
             attn_weights[f"encoder_layer{i + 1}"] = w
     if cfg.norm_scheme == "pre":

@@ -257,6 +257,8 @@ def pipelined_transformer_apply(
                 lp, h, None, smask, None, cfg, r, deterministic
             )[0]
 
+        if cfg.remat:
+            dec_layer = jax.checkpoint(dec_layer)
         x = pipeline_apply(
             stacked, dec_layer, x, (self_mask,),
             mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
@@ -279,6 +281,11 @@ def pipelined_transformer_apply(
     def enc_layer(lp, h, r, mask):
         return encoder_layer_apply(lp, h, mask, cfg, r, deterministic)[0]
 
+    if cfg.remat:
+        # Same activation-memory lever as the sequential path (encoder_apply /
+        # decoder_apply wrap their layer calls); without this the flag would
+        # silently do nothing under pipeline parallelism.
+        enc_layer = jax.checkpoint(enc_layer)
     enc_out = pipeline_apply(
         enc_stacked, enc_layer, x, (enc_mask,),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_enc,
@@ -299,6 +306,8 @@ def pipelined_transformer_apply(
             lp, h, enc_mb, smask, cmask, cfg, r, deterministic
         )[0]
 
+    if cfg.remat:
+        dec_layer = jax.checkpoint(dec_layer)
     y = pipeline_apply(
         dec_stacked, dec_layer, y, (enc_out, self_mask, enc_mask),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
