@@ -55,6 +55,10 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"training on {dev.platform}:{dev.device_kind}", file=sys.stderr)
 
+    # Length buckets: most bundled-corpus sentences are far shorter than 50
+    # tokens; three widths cut padding FLOPs roughly in half at the cost of
+    # three compiles.
+    buckets = (24, 36, args.seq_len) if args.seq_len >= 48 else ()
     train_ds, test_ds, src_tok, tgt_tok = load_dataset(
         os.path.join(REPO, "data"),
         os.path.join(args.workdir, "src_vocab.subwords"),
@@ -63,6 +67,7 @@ def main() -> None:
         sequence_length=args.seq_len,
         target_vocab_size=args.vocab,
         seed=0,
+        length_buckets=buckets,
     )
     shapes = {
         "tiny": dict(num_layers=2, d_model=128, num_heads=4, dff=512),

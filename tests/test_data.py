@@ -136,6 +136,99 @@ class TestDataset:
         assert (total_rows != 0).any(axis=1).sum() == 10
 
 
+class TestLengthBuckets:
+    def _mk(self, n=40, batch=4, **kw):
+        # max(len(src), len(tgt)) in [3, 10): lands in both buckets of (6, 10)
+        src = [np.arange(1, 3 + (i % 5), dtype=np.int32) for i in range(n)]
+        tgt = [np.arange(1, 3 + (i % 8), dtype=np.int32) for i in range(n)]
+        return Seq2SeqDataset(
+            src, tgt, batch_size=batch, src_len=10, tgt_len=10,
+            length_buckets=(6, 10), **kw,
+        )
+
+    def test_batch_widths_match_buckets_and_cover_all(self):
+        ds = self._mk()
+        widths = set()
+        n_rows = 0
+        for src, tgt in ds.batches(0):
+            assert src.shape == tgt.shape
+            assert src.shape[1] in (6, 10)
+            widths.add(src.shape[1])
+            # every row fits its bucket (no mid-sentence truncation)
+            n_rows += (src != 0).any(axis=1).sum()
+        assert widths == {6, 10}  # both buckets actually used
+        assert len(list(ds.batches(0))) == len(ds)
+
+    def test_examples_land_in_smallest_fitting_bucket(self):
+        ds = self._mk(shuffle=False)
+        for src, tgt in ds.batches(0):
+            if src.shape[1] == 10:
+                # at least one row needs > 6: otherwise it belongs in bucket 6
+                longest = np.maximum(
+                    (src != 0).sum(axis=1), (tgt != 0).sum(axis=1)
+                )
+                assert longest.max() > 6
+
+    def test_deterministic_and_epoch_varying(self):
+        ds = self._mk()
+        a = [(s.copy(), s.shape) for s, _ in ds.batches(2)]
+        b = [(s.copy(), s.shape) for s, _ in ds.batches(2)]
+        for (x, shx), (y, shy) in zip(a, b):
+            assert shx == shy
+            np.testing.assert_array_equal(x, y)
+
+    def test_sharding_partitions_bucketed_batch(self):
+        full = self._mk(shard_index=0, shard_count=1)
+        s0 = self._mk(shard_index=0, shard_count=2)
+        s1 = self._mk(shard_index=1, shard_count=2)
+        for (f, _), (a, _), (b, _) in zip(
+            full.batches(1), s0.batches(1), s1.batches(1)
+        ):
+            np.testing.assert_array_equal(np.concatenate([a, b], 0), f)
+
+    def test_tail_handling_no_drop(self):
+        ds = self._mk(n=10, batch=4, shuffle=False, drop_remainder=False)
+        rows = 0
+        for src, _ in ds.batches(0):
+            rows += (src != 0).any(axis=1).sum()
+        assert rows == 10  # every example appears despite bucketed tails
+
+    def test_prefetch_rejected(self):
+        with pytest.raises(ValueError, match="prefetch"):
+            self._mk(prefetch=True)
+
+    def test_overlong_examples_rejected_not_clamped(self):
+        """A largest bucket narrower than the data must fail loudly — silent
+        clamping would truncate sentences (and their EOS) mid-stream."""
+        src = [np.arange(1, 9, dtype=np.int32)]  # length 8 > largest bucket 6
+        with pytest.raises(ValueError, match="exceed the largest"):
+            Seq2SeqDataset(
+                src, src, batch_size=1, src_len=10, tgt_len=10,
+                length_buckets=(4, 6),
+            )
+
+    def test_trains_through_trainer(self):
+        """End-to-end: a jitted train step accepts both bucket widths (one
+        compile each, no errors from the changing static shape)."""
+        import jax
+
+        from transformer_tpu.config import ModelConfig, TrainConfig
+        from transformer_tpu.train import create_train_state, make_train_step
+
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=16, target_vocab_size=16, max_position=16,
+            dtype="float32", dropout_rate=0.0,
+        )
+        tcfg = TrainConfig(batch_size=4, sequence_length=10, warmup_steps=5)
+        state = create_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+        step = jax.jit(make_train_step(cfg, tcfg))
+        rng = jax.random.PRNGKey(1)
+        for src, tgt in self._mk().batches(0):
+            state, m = step(state, src, tgt, rng)
+            assert np.isfinite(float(m["loss"]))
+
+
 class TestLoadDataset:
     @pytest.fixture()
     def corpus_dir(self, tmp_path):

@@ -162,6 +162,45 @@ class TestTrainStep:
         assert last < 0.4 * first, (first, last)
         assert int(state.step) == 150
 
+    def test_grad_accum_matches_whole_batch(self):
+        """grad_accum_steps=4 must produce the same optimizer trajectory as
+        the whole-batch step (dropout off), for both normalizations."""
+        import dataclasses
+
+        for norm in ("tokens", "batch"):
+            base = TCFG if TCFG.loss_normalization == norm else dataclasses.replace(
+                TCFG, loss_normalization=norm
+            )
+            accum_cfg = dataclasses.replace(base, grad_accum_steps=4)
+            src = jax.random.randint(jax.random.PRNGKey(1), (8, 8), 1, 30)
+            tgt = jax.random.randint(jax.random.PRNGKey(2), (8, 8), 1, 30)
+            tgt = tgt.at[:, 6:].set(0)  # pad tail: exercise token weighting
+            rng = jax.random.PRNGKey(3)
+
+            s_ref = create_train_state(jax.random.PRNGKey(0), TINY, base)
+            s_acc = create_train_state(jax.random.PRNGKey(0), TINY, accum_cfg)
+            step_ref = jax.jit(make_train_step(TINY, base))
+            step_acc = jax.jit(make_train_step(TINY, accum_cfg))
+            for _ in range(3):
+                s_ref, m_ref = step_ref(s_ref, src, tgt, rng)
+                s_acc, m_acc = step_acc(s_acc, src, tgt, rng)
+                np.testing.assert_allclose(
+                    float(m_acc["loss"]), float(m_ref["loss"]), rtol=2e-5,
+                    err_msg=norm,
+                )
+
+    def test_grad_accum_must_divide_batch(self):
+        import dataclasses
+
+        import pytest
+
+        cfg = dataclasses.replace(TCFG, grad_accum_steps=3)
+        state = create_train_state(jax.random.PRNGKey(0), TINY, cfg)
+        step = jax.jit(make_train_step(TINY, cfg))
+        src = jax.random.randint(jax.random.PRNGKey(1), (8, 8), 1, 30)
+        with pytest.raises(ValueError, match="divide"):
+            step(state, src, src, jax.random.PRNGKey(2))
+
     def test_eval_step_deterministic(self):
         state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
         eval_step = jax.jit(make_eval_step(TINY, TCFG))

@@ -59,6 +59,11 @@ def define_flags() -> None:
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
     flags.DEFINE_boolean("native_loader", True,
                          "prefetch batches via the C++ loader when available")
+    flags.DEFINE_string(
+        "length_buckets", "",
+        "comma-separated ascending batch widths (e.g. '24,36,50', last <= "
+        "sequence_length): batches pad to the smallest fitting bucket — "
+        "one compile per bucket, far fewer padding FLOPs ('' = off)")
     flags.DEFINE_string("profile_dir", "", "capture a jax.profiler trace into this dir")
     flags.DEFINE_integer("profile_start_step", 2, "first step of the profile window")
     flags.DEFINE_integer("profile_num_steps", 3, "profile window length in steps")
@@ -79,6 +84,9 @@ def define_flags() -> None:
     flags.DEFINE_integer(
         "eval_max_batches", 8,
         "cap on in-loop eval batches (0 = full test set each eval)")
+    flags.DEFINE_integer(
+        "grad_accum", 1,
+        "gradient-accumulation micro-steps per optimizer update (1 = off)")
     flags.DEFINE_boolean(
         "eval_bleu", True,
         "compute corpus BLEU on the test split after training")
@@ -123,6 +131,7 @@ def flags_to_train_config() -> TrainConfig:
         seed=FLAGS.seed,
         pp_microbatches=FLAGS.pp_microbatches,
         eval_max_batches=FLAGS.eval_max_batches,
+        grad_accum_steps=FLAGS.grad_accum,
     )
 
 

@@ -39,6 +39,9 @@ def main(argv) -> None:
     from transformer_tpu.train.decode import translate
 
     train_cfg = flags_to_train_config()
+    buckets = tuple(
+        int(x) for x in FLAGS.length_buckets.split(",") if x.strip()
+    )
     train_ds, test_ds, src_tok, tgt_tok = load_dataset(
         FLAGS.dataset_path,
         FLAGS.src_vocab_file,
@@ -47,7 +50,8 @@ def main(argv) -> None:
         sequence_length=train_cfg.sequence_length,
         target_vocab_size=FLAGS.target_vocab_size,
         seed=train_cfg.seed,
-        prefetch=FLAGS.native_loader,
+        prefetch=FLAGS.native_loader and not buckets,
+        length_buckets=buckets,
     )
     logging.info(
         "data: %d train examples, vocabs %d/%d",

@@ -130,6 +130,10 @@ class TrainConfig:
     # GPipe microbatches per step when the mesh has a pipe axis; 0 = one
     # microbatch per stage (parallel/pipeline.py).
     pp_microbatches: int = 0
+    # Gradient accumulation: split each batch into this many sequential
+    # micro-steps and sum gradients before one optimizer update — train
+    # big-model global batches on small-HBM chips. 1 = off.
+    grad_accum_steps: int = 1
 
     def __post_init__(self) -> None:
         if self.loss_normalization not in ("tokens", "batch"):

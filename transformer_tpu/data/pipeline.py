@@ -106,6 +106,15 @@ class Seq2SeqDataset:
     drop_remainder: bool = True
     shard_index: int = 0
     shard_count: int = 1
+    # Length bucketing: a small ascending tuple of widths (e.g. (24, 36, 50),
+    # last == src_len/tgt_len). Each example lands in the smallest bucket that
+    # fits max(len(src), len(tgt)); batches are formed within buckets and
+    # padded to the bucket width only. XLA compiles once per bucket —
+    # len(buckets) static shapes instead of one — and short sentences stop
+    # paying full-sequence-length FLOPs (the reference's per-batch ragged
+    # padding, utils.py:154, bought the same saving at the cost of a
+    # recompile per batch shape). () = single fixed width.
+    length_buckets: tuple[int, ...] = ()
     # Opt-in C++ prefetching loader (transformer_tpu/native/dataloader.cc):
     # batch assembly runs in a background thread, overlapped with device
     # steps. Shuffle order differs from the Python path (splitmix64
@@ -124,12 +133,50 @@ class Seq2SeqDataset:
                 f"global batch size {self.batch_size} not divisible by "
                 f"shard count {self.shard_count}"
             )
+        if self.length_buckets:
+            self.length_buckets = tuple(sorted(self.length_buckets))
+            if self.prefetch:
+                raise ValueError(
+                    "length_buckets is not supported with the native "
+                    "prefetch loader; pass prefetch=False"
+                )
+            if self.length_buckets[-1] > max(self.src_len, self.tgt_len):
+                raise ValueError(
+                    f"largest bucket {self.length_buckets[-1]} exceeds the "
+                    f"dataset width {max(self.src_len, self.tgt_len)}"
+                )
+            lengths = np.asarray(
+                [max(len(s), len(t)) for s, t in zip(self.src, self.tgt)]
+            )
+            if lengths.size and int(lengths.max()) > self.length_buckets[-1]:
+                # Refuse rather than silently clamp: clamping would cut
+                # sentences mid-stream (and drop their EOS) with no
+                # diagnostic. The largest bucket must cover the data — for
+                # load_dataset that means buckets[-1] == sequence_length.
+                n_over = int((lengths > self.length_buckets[-1]).sum())
+                raise ValueError(
+                    f"{n_over} examples exceed the largest length bucket "
+                    f"{self.length_buckets[-1]} (longest is "
+                    f"{int(lengths.max())}); make the last bucket as wide as "
+                    "the length filter (sequence_length)"
+                )
+            # Example i -> smallest bucket that fits it.
+            which = np.searchsorted(np.asarray(self.length_buckets), lengths)
+            self._bucket_members = [
+                np.flatnonzero(which == b)
+                for b in range(len(self.length_buckets))
+            ]
+
+    def _batches_per_bucket(self, n: int) -> int:
+        full, rem = divmod(n, self.batch_size)
+        return full + (1 if rem and not self.drop_remainder else 0)
 
     def __len__(self) -> int:
-        n = len(self.src) // self.batch_size
-        if not self.drop_remainder and len(self.src) % self.batch_size:
-            n += 1
-        return n
+        if self.length_buckets:
+            return sum(
+                self._batches_per_bucket(len(m)) for m in self._bucket_members
+            )
+        return self._batches_per_bucket(len(self.src))
 
     @property
     def num_examples(self) -> int:
@@ -151,6 +198,9 @@ class Seq2SeqDataset:
         return self._native or None
 
     def batches(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        if self.length_buckets:
+            yield from self._bucketed_batches(epoch)
+            return
         if self.prefetch:
             loader = self._native_loader()
             if loader is not None:
@@ -196,14 +246,52 @@ class Seq2SeqDataset:
                 global_idx = np.concatenate([global_idx, fill])
             yield self._pad(global_idx[lo : lo + local])
 
-    def _pad(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        src = np.full((len(idx), self.src_len), PAD_ID, dtype=np.int32)
-        tgt = np.full((len(idx), self.tgt_len), PAD_ID, dtype=np.int32)
+    def _bucketed_batches(
+        self, epoch: int
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Form batches inside each length bucket, then emit them in a
+        (seed, epoch)-shuffled global order so an epoch interleaves widths
+        (all-short-first would skew the gradient distribution mid-epoch).
+        Deterministic across hosts: same permutations on every process."""
+        rng = np.random.default_rng((self.seed, epoch))
+        plan: list[tuple[int, np.ndarray]] = []
+        for b, members in enumerate(self._bucket_members):
+            perm = (
+                members[rng.permutation(len(members))]
+                if self.shuffle
+                else members
+            )
+            n_batches = self._batches_per_bucket(len(perm))
+            for k in range(n_batches):
+                gidx = perm[k * self.batch_size : (k + 1) * self.batch_size]
+                if len(gidx) < self.batch_size:
+                    fill = np.full(
+                        self.batch_size - len(gidx), -1, dtype=np.int64
+                    )
+                    gidx = np.concatenate([gidx, fill])
+                plan.append((self.length_buckets[b], gidx))
+        if self.shuffle:
+            rng.shuffle(plan)
+        local = self.batch_size // self.shard_count
+        lo = self.shard_index * local
+        for width, gidx in plan:
+            yield self._pad(gidx[lo : lo + local], width, width)
+
+    def _pad(
+        self,
+        idx: np.ndarray,
+        src_len: int | None = None,
+        tgt_len: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        src_len = self.src_len if src_len is None else src_len
+        tgt_len = self.tgt_len if tgt_len is None else tgt_len
+        src = np.full((len(idx), src_len), PAD_ID, dtype=np.int32)
+        tgt = np.full((len(idx), tgt_len), PAD_ID, dtype=np.int32)
         for row, i in enumerate(idx):
             if i < 0:
                 continue  # padding row
-            s = self.src[i][: self.src_len]  # over-length examples truncate
-            t = self.tgt[i][: self.tgt_len]
+            s = self.src[i][:src_len]  # over-length examples truncate
+            t = self.tgt[i][:tgt_len]
             src[row, : len(s)] = s
             tgt[row, : len(t)] = t
         return src, tgt
@@ -225,6 +313,7 @@ def load_dataset(
     shard_count: int = 1,
     require_test: bool = False,
     prefetch: bool = False,
+    length_buckets: tuple[int, ...] = (),
 ) -> tuple[Seq2SeqDataset, Seq2SeqDataset | None, SubwordTokenizer, SubwordTokenizer]:
     """Build train (+ optional test) datasets plus both tokenizers —
     the counterpart of reference ``load_dataset`` (``utils.py:114-161``).
@@ -256,7 +345,8 @@ def load_dataset(
         seed=seed,
         shard_index=shard_index,
         shard_count=shard_count,
-        prefetch=prefetch,
+        prefetch=prefetch,  # Seq2SeqDataset rejects prefetch+buckets itself
+        length_buckets=length_buckets,
     )
 
     test: Seq2SeqDataset | None = None

@@ -57,6 +57,15 @@ def make_train_step(
     tx = tx or make_optimizer(model_cfg, train_cfg)
     if forward_fn is None:
         forward_fn = _default_forward(model_cfg)
+    accum = max(1, train_cfg.grad_accum_steps)
+
+    def _apply(state, grads, metrics):
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        return new_state, metrics
 
     def train_step(state: TrainState, src, tgt, rng):
         tar_inp, tar_out = _shift_targets(tgt)
@@ -72,14 +81,65 @@ def make_train_step(
             )
 
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
-        )
-        return new_state, {"loss": loss, **metrics}
+        return _apply(state, grads, {"loss": loss, **metrics})
 
-    return train_step
+    def accum_train_step(state: TrainState, src, tgt, rng):
+        """Gradient accumulation: lax.scan over ``accum`` micro-steps, each a
+        full forward/backward on 1/accum of the batch; gradients are summed
+        in the un-normalized (loss-SUM) domain and divided once at the end,
+        so the update equals the whole-batch gradient exactly (for "tokens"
+        normalization the denominator is the global non-pad token count —
+        chunk-mean averaging would weight chunks unequally)."""
+        tar_inp, tar_out = _shift_targets(tgt)
+        step_rng = jax.random.fold_in(rng, state.step)
+        batch = src.shape[0]
+        if batch % accum:
+            raise ValueError(
+                f"grad_accum_steps {accum} must divide the batch {batch}"
+            )
+        mb = batch // accum
+        chunks = (
+            src.reshape(accum, mb, *src.shape[1:]),
+            tar_inp.reshape(accum, mb, *tar_inp.shape[1:]),
+            tar_out.reshape(accum, mb, *tar_out.shape[1:]),
+            jnp.arange(accum),
+        )
+
+        def sum_loss_fn(params, s, ti, to, r):
+            logits = forward_fn(params, s, ti, r, False)
+            _, m = masked_cross_entropy(
+                logits, to,
+                label_smoothing=train_cfg.label_smoothing,
+                normalization="tokens",  # only the sums are consumed
+            )
+            return m["loss_sum"], m
+
+        grad_fn = jax.grad(sum_loss_fn, has_aux=True)
+
+        def body(acc, chunk):
+            acc_g, acc_m = acc
+            s, ti, to, i = chunk
+            g, m = grad_fn(state.params, s, ti, to, jax.random.fold_in(step_rng, i))
+            acc_g = jax.tree.map(jnp.add, acc_g, g)
+            acc_m = {k: acc_m[k] + m[k] for k in acc_m}
+            return (acc_g, acc_m), None
+
+        zero_g = jax.tree.map(jnp.zeros_like, state.params)
+        zero_m = {
+            "loss_sum": jnp.zeros((), jnp.float32),
+            "weight": jnp.zeros((), jnp.float32),
+            "correct": jnp.zeros((), jnp.float32),
+        }
+        (grads, m), _ = jax.lax.scan(body, (zero_g, zero_m), chunks)
+        if train_cfg.loss_normalization == "tokens":
+            denom = jnp.maximum(m["weight"], 1.0)
+        else:  # "batch": the reference's rule, train.py:88
+            denom = jnp.float32(train_cfg.batch_size)
+        grads = jax.tree.map(lambda g: g / denom, grads)
+        loss = m["loss_sum"] / denom
+        return _apply(state, grads, {"loss": loss, **m})
+
+    return accum_train_step if accum > 1 else train_step
 
 
 def _default_forward(model_cfg: ModelConfig) -> Callable:
@@ -250,7 +310,9 @@ class Trainer:
                         self.profiler.maybe_trace(step, block_on=self.state)
                     self.state, m = self.train_step(self.state, src, tgt, rng)
                     self.train_metrics.update(m)
-                    self.step_timer.tick()
+                    # Actual target tokens this step (length-bucketed batches
+                    # are narrower than the nominal sequence_length).
+                    self.step_timer.tick(src.shape[0] * max(tgt.shape[1] - 1, 1))
                     step += 1
                     if guard.should_stop:
                         self._preempt(step, guard)

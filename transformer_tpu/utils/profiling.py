@@ -96,15 +96,20 @@ class StepTimer:
 
     def reset(self) -> None:
         self._window_steps = 0
+        self._window_tokens = 0
         self._window_start: float | None = None
         self._total_steps = 0
+        self._total_tokens = 0
         self._total_time = 0.0
 
-    def tick(self) -> None:
-        """Call once per dispatched step."""
+    def tick(self, tokens: int | None = None) -> None:
+        """Call once per dispatched step. ``tokens`` overrides the fixed
+        ``tokens_per_step`` for that step — length-bucketed batches process
+        fewer tokens than the nominal batch×sequence_length."""
         if self._window_start is None:
             self._window_start = time.perf_counter()
         self._window_steps += 1
+        self._window_tokens += self.tokens_per_step if tokens is None else tokens
 
     def sync(self) -> None:
         """Close the current window — call immediately after a blocking read
@@ -113,7 +118,9 @@ class StepTimer:
             return
         self._total_time += time.perf_counter() - self._window_start
         self._total_steps += self._window_steps
+        self._total_tokens += self._window_tokens
         self._window_steps = 0
+        self._window_tokens = 0
         self._window_start = None
 
     @property
@@ -130,7 +137,7 @@ class StepTimer:
 
     @property
     def tokens_per_sec(self) -> float:
-        return self.steps_per_sec * self.tokens_per_step
+        return self._total_tokens / self._total_time if self._total_time > 0 else 0.0
 
     def summary(self) -> str:
         if not self._total_steps:
@@ -139,6 +146,6 @@ class StepTimer:
             f"{self.count} steps: mean {self.mean_s * 1e3:.1f}ms "
             f"({self.steps_per_sec:.2f} steps/s"
         )
-        if self.tokens_per_step:
+        if self._total_tokens:
             msg += f", {self.tokens_per_sec:,.0f} tokens/s"
         return msg + ")"
