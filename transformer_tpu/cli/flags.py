@@ -47,6 +47,11 @@ def define_flags() -> None:
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
+    flags.DEFINE_boolean(
+        "decoder_only", False,
+        "causal-LM mode (cli.train): train a decoder-only model on the "
+        "target-side corpus chunked into sequence_length windows "
+        "(BASELINE configs[4]); translation-side flags are ignored")
     flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring", "ulysses"],
                       "attention kernel (ring/ulysses = sequence-parallel, use with --sp>1)")
     flags.DEFINE_string("dtype", "bfloat16", "compute dtype")
@@ -106,6 +111,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         dropout_rate=FLAGS.dropout_rate,
         max_position=max(FLAGS.sequence_length, 64),
         norm_scheme=FLAGS.norm_scheme,
+        decoder_only=FLAGS.decoder_only,
         tie_embeddings=FLAGS.tie_embeddings,
         tie_output=FLAGS.tie_output,
         ffn_activation="relu",

@@ -42,17 +42,58 @@ def main(argv) -> None:
     buckets = tuple(
         int(x) for x in FLAGS.length_buckets.split(",") if x.strip()
     )
-    train_ds, test_ds, src_tok, tgt_tok = load_dataset(
-        FLAGS.dataset_path,
-        FLAGS.src_vocab_file,
-        FLAGS.tgt_vocab_file,
-        batch_size=train_cfg.batch_size,
-        sequence_length=train_cfg.sequence_length,
-        target_vocab_size=FLAGS.target_vocab_size,
-        seed=train_cfg.seed,
-        prefetch=FLAGS.native_loader and not buckets,
-        length_buckets=buckets,
-    )
+    if FLAGS.decoder_only:
+        if buckets:
+            raise app.UsageError(
+                "--length_buckets applies to the seq2seq pipeline only; LM "
+                "windows are already fixed-width (drop the flag with "
+                "--decoder_only)"
+            )
+        # Causal-LM mode: the target-side corpus as one chunked token stream
+        # (the data path behind the long-context decoder-only config).
+        from transformer_tpu.data.pipeline import (
+            load_or_build_tokenizer,
+            make_lm_dataset,
+            read_parallel_corpus,
+        )
+
+        _, tgt_lines = read_parallel_corpus(FLAGS.dataset_path, "train")
+        tok = load_or_build_tokenizer(
+            FLAGS.tgt_vocab_file, tgt_lines, FLAGS.target_vocab_size
+        )
+        train_ds = make_lm_dataset(
+            tgt_lines, tok,
+            batch_size=train_cfg.batch_size,
+            sequence_length=train_cfg.sequence_length,
+            seed=train_cfg.seed,
+        )
+        try:
+            _, test_tgt = read_parallel_corpus(FLAGS.dataset_path, "test")
+            # Eval must see every window exactly once: no shuffle, keep the
+            # (zero-weight-padded) tail batch.
+            test_ds = make_lm_dataset(
+                test_tgt, tok,
+                batch_size=train_cfg.batch_size,
+                sequence_length=train_cfg.sequence_length,
+                seed=train_cfg.seed,
+                shuffle=False,
+                drop_remainder=False,
+            )
+        except (FileNotFoundError, ValueError):
+            test_ds = None
+        src_tok = tgt_tok = tok
+    else:
+        train_ds, test_ds, src_tok, tgt_tok = load_dataset(
+            FLAGS.dataset_path,
+            FLAGS.src_vocab_file,
+            FLAGS.tgt_vocab_file,
+            batch_size=train_cfg.batch_size,
+            sequence_length=train_cfg.sequence_length,
+            target_vocab_size=FLAGS.target_vocab_size,
+            seed=train_cfg.seed,
+            prefetch=FLAGS.native_loader and not buckets,
+            length_buckets=buckets,
+        )
     logging.info(
         "data: %d train examples, vocabs %d/%d",
         train_ds.num_examples, src_tok.vocab_size, tgt_tok.vocab_size,
@@ -78,19 +119,34 @@ def main(argv) -> None:
     )
     trainer.fit(train_ds, test_ds)
 
-    sample = "he go to school"
-    out = translate(
-        trainer.state.params, model_cfg, src_tok, tgt_tok, sample,
-        max_len=train_cfg.sequence_length,
-    )
-    logging.info("sample translation %r -> %r", sample, out[0])
+    if FLAGS.decoder_only:
+        # LM quality metric: perplexity from fit()'s final-epoch full eval
+        # (trainer.evaluate already ran over the whole split; re-running it
+        # here would double end-of-run eval time for the same number).
+        if test_ds is not None and trainer.eval_metrics.weight > 0:
+            import math
+
+            logging.info(
+                "eval loss %.4f, perplexity %.2f",
+                trainer.eval_metrics.loss,
+                math.exp(min(trainer.eval_metrics.loss, 30.0)),
+            )
+        elif test_ds is not None:
+            logging.warning("eval split produced no tokens; no perplexity")
+    else:
+        sample = "he go to school"
+        out = translate(
+            trainer.state.params, model_cfg, src_tok, tgt_tok, sample,
+            max_len=train_cfg.sequence_length,
+        )
+        logging.info("sample translation %r -> %r", sample, out[0])
     export_params(trainer.state.params, model_cfg, "model")
     logging.info("exported params to ./model")
 
     # End-of-run quality metric (BASELINE.json north star): corpus BLEU on
     # the test split, when one exists. The reference never computes any
     # translation-quality metric (token accuracy only, train.py:140-141).
-    if FLAGS.eval_bleu:
+    if FLAGS.eval_bleu and not FLAGS.decoder_only:
         import glob as _glob
 
         src_tests = sorted(

@@ -301,6 +301,66 @@ def _round_up(n: int, multiple: int = 8) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def make_lm_dataset(
+    lines: list[str],
+    tok: SubwordTokenizer,
+    batch_size: int,
+    sequence_length: int,
+    seed: int = 0,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    shuffle: bool = True,
+    drop_remainder: bool = True,
+) -> Seq2SeqDataset:
+    """Causal-LM dataset: the corpus as one token stream, chunked into
+    fixed ``sequence_length`` windows (the data path for the decoder-only /
+    long-context configs — BASELINE configs[4]; no reference counterpart,
+    the reference is seq2seq-only).
+
+    Documents are joined with EOS separators; each window is BOS-prefixed so
+    the decode convention matches translation (BOS feeds position 0). The
+    same ``Seq2SeqDataset`` machinery provides shuffling/sharding; src is
+    the window itself (``transformer_apply`` ignores ``inp`` when
+    ``cfg.decoder_only``).
+    """
+    stream: list[np.ndarray] = []
+    for line in lines:
+        ids = tok.encode(line)
+        if ids:
+            stream.append(np.asarray(ids + [tok.eos_id], dtype=np.int32))
+    if not stream:
+        raise ValueError("empty corpus for LM dataset")
+    flat = np.concatenate(stream)
+    # Windows carry BOS + (sequence_length - 1) stream tokens: teacher
+    # forcing shifts inside the train step, so consecutive windows need no
+    # overlap.
+    body = sequence_length - 1
+    n_windows = len(flat) // body
+    if n_windows == 0:
+        raise ValueError(
+            f"corpus ({len(flat)} tokens) shorter than one "
+            f"{sequence_length}-token window"
+        )
+    windows = [
+        np.concatenate(
+            [[tok.bos_id], flat[i * body : (i + 1) * body]]
+        ).astype(np.int32)
+        for i in range(n_windows)
+    ]
+    return Seq2SeqDataset(
+        windows,
+        windows,
+        batch_size=batch_size,
+        src_len=sequence_length,
+        tgt_len=sequence_length,
+        shuffle=shuffle,
+        seed=seed,
+        shard_index=shard_index,
+        shard_count=shard_count,
+        drop_remainder=drop_remainder,
+    )
+
+
 def load_dataset(
     dataset_path: str,
     src_vocab_file: str,
