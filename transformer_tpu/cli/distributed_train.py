@@ -52,18 +52,43 @@ def main(argv) -> None:
     )
 
     train_cfg = flags_to_train_config()
-    train_ds, test_ds, src_tok, tgt_tok = load_dataset(
-        FLAGS.dataset_path,
-        FLAGS.src_vocab_file,
-        FLAGS.tgt_vocab_file,
-        batch_size=train_cfg.batch_size,
-        sequence_length=train_cfg.sequence_length,
-        target_vocab_size=FLAGS.target_vocab_size,
-        seed=train_cfg.seed,
-        shard_index=jax.process_index(),
-        shard_count=jax.process_count(),
-        prefetch=FLAGS.native_loader,
+    buckets = tuple(
+        int(x) for x in FLAGS.length_buckets.split(",") if x.strip()
     )
+    if FLAGS.decoder_only:
+        if buckets:
+            raise app.UsageError(
+                "--length_buckets applies to the seq2seq pipeline only; LM "
+                "windows are already fixed-width (drop the flag with "
+                "--decoder_only)"
+            )
+        from transformer_tpu.data.pipeline import load_lm_splits
+
+        train_ds, test_ds, tok = load_lm_splits(
+            FLAGS.dataset_path,
+            FLAGS.tgt_vocab_file,
+            batch_size=train_cfg.batch_size,
+            sequence_length=train_cfg.sequence_length,
+            target_vocab_size=FLAGS.target_vocab_size,
+            seed=train_cfg.seed,
+            shard_index=jax.process_index(),
+            shard_count=jax.process_count(),
+        )
+        src_tok = tgt_tok = tok
+    else:
+        train_ds, test_ds, src_tok, tgt_tok = load_dataset(
+            FLAGS.dataset_path,
+            FLAGS.src_vocab_file,
+            FLAGS.tgt_vocab_file,
+            batch_size=train_cfg.batch_size,
+            sequence_length=train_cfg.sequence_length,
+            target_vocab_size=FLAGS.target_vocab_size,
+            seed=train_cfg.seed,
+            shard_index=jax.process_index(),
+            shard_count=jax.process_count(),
+            prefetch=FLAGS.native_loader and not buckets,
+            length_buckets=buckets,
+        )
     model_cfg = flags_to_model_config(
         src_tok.model_vocab_size, tgt_tok.model_vocab_size
     )
@@ -85,12 +110,13 @@ def main(argv) -> None:
     trainer.fit(train_ds, test_ds)
 
     if jax.process_index() == 0:
-        sample = ["he goes to school"]
-        out = translate(
-            trainer.state.params, model_cfg, src_tok, tgt_tok, sample,
-            max_len=train_cfg.sequence_length,
-        )
-        logging.info("sample translation %r -> %r", sample[0], out[0])
+        if not FLAGS.decoder_only:
+            sample = ["he goes to school"]
+            out = translate(
+                trainer.state.params, model_cfg, src_tok, tgt_tok, sample,
+                max_len=train_cfg.sequence_length,
+            )
+            logging.info("sample translation %r -> %r", sample[0], out[0])
         export_params(trainer.state.params, model_cfg, "model")
         logging.info("exported params to ./model")
 

@@ -49,9 +49,10 @@ def define_flags() -> None:
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
     flags.DEFINE_boolean(
         "decoder_only", False,
-        "causal-LM mode (cli.train): train a decoder-only model on the "
-        "target-side corpus chunked into sequence_length windows "
-        "(BASELINE configs[4]); translation-side flags are ignored")
+        "causal-LM mode (cli.train and cli.distributed_train): train a "
+        "decoder-only model on the target-side corpus chunked into "
+        "sequence_length windows (BASELINE configs[4]); translation-side "
+        "flags are ignored")
     flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring", "ulysses"],
                       "attention kernel (ring/ulysses = sequence-parallel, use with --sp>1)")
     flags.DEFINE_string("dtype", "bfloat16", "compute dtype")

@@ -51,36 +51,16 @@ def main(argv) -> None:
             )
         # Causal-LM mode: the target-side corpus as one chunked token stream
         # (the data path behind the long-context decoder-only config).
-        from transformer_tpu.data.pipeline import (
-            load_or_build_tokenizer,
-            make_lm_dataset,
-            read_parallel_corpus,
-        )
+        from transformer_tpu.data.pipeline import load_lm_splits
 
-        _, tgt_lines = read_parallel_corpus(FLAGS.dataset_path, "train")
-        tok = load_or_build_tokenizer(
-            FLAGS.tgt_vocab_file, tgt_lines, FLAGS.target_vocab_size
-        )
-        train_ds = make_lm_dataset(
-            tgt_lines, tok,
+        train_ds, test_ds, tok = load_lm_splits(
+            FLAGS.dataset_path,
+            FLAGS.tgt_vocab_file,
             batch_size=train_cfg.batch_size,
             sequence_length=train_cfg.sequence_length,
+            target_vocab_size=FLAGS.target_vocab_size,
             seed=train_cfg.seed,
         )
-        try:
-            _, test_tgt = read_parallel_corpus(FLAGS.dataset_path, "test")
-            # Eval must see every window exactly once: no shuffle, keep the
-            # (zero-weight-padded) tail batch.
-            test_ds = make_lm_dataset(
-                test_tgt, tok,
-                batch_size=train_cfg.batch_size,
-                sequence_length=train_cfg.sequence_length,
-                seed=train_cfg.seed,
-                shuffle=False,
-                drop_remainder=False,
-            )
-        except (FileNotFoundError, ValueError):
-            test_ds = None
         src_tok = tgt_tok = tok
     else:
         train_ds, test_ds, src_tok, tgt_tok = load_dataset(

@@ -361,6 +361,50 @@ def make_lm_dataset(
     )
 
 
+def load_lm_splits(
+    dataset_path: str,
+    vocab_file: str,
+    batch_size: int,
+    sequence_length: int,
+    target_vocab_size: int = 2**15,
+    seed: int = 0,
+    shard_index: int = 0,
+    shard_count: int = 1,
+) -> tuple[Seq2SeqDataset, Seq2SeqDataset | None, SubwordTokenizer]:
+    """Causal-LM train (+ optional test) datasets over the target-side
+    corpus — the single loading path shared by ``cli.train --decoder_only``
+    and ``cli.distributed_train --decoder_only``. Eval sees every window
+    exactly once (unshuffled, zero-weight-padded tail batch)."""
+    _, tgt_lines = read_parallel_corpus(dataset_path, "train")
+    tok = load_or_build_tokenizer(vocab_file, tgt_lines, target_vocab_size)
+    train = make_lm_dataset(
+        tgt_lines, tok,
+        batch_size=batch_size,
+        sequence_length=sequence_length,
+        seed=seed,
+        shard_index=shard_index,
+        shard_count=shard_count,
+    )
+    test: Seq2SeqDataset | None
+    try:
+        _, test_tgt = read_parallel_corpus(dataset_path, "test")
+        test = make_lm_dataset(
+            test_tgt, tok,
+            batch_size=batch_size,
+            sequence_length=sequence_length,
+            seed=seed,
+            shard_index=shard_index,
+            shard_count=shard_count,
+            shuffle=False,
+            drop_remainder=False,
+        )
+    except FileNotFoundError:
+        test = None
+    except ValueError:
+        test = None  # test split shorter than one window
+    return train, test, tok
+
+
 def load_dataset(
     dataset_path: str,
     src_vocab_file: str,
