@@ -158,6 +158,37 @@ class TestParity:
             losses.append(float(m["loss"]))
         np.testing.assert_allclose(losses, single[0], rtol=2e-4)
 
+    def test_decoder_only_on_mesh_matches_single(self):
+        """The decoder-only param tree (no encoder/cross_mha/ln2) must shard
+        and train on a data×fsdp mesh, matching the single-device run."""
+        import dataclasses
+
+        lm_model = dataclasses.replace(MODEL, decoder_only=True)
+        batches = [_batch(i) for i in range(3)]
+
+        state = create_train_state(jax.random.PRNGKey(0), lm_model, TCFG)
+        step = jax.jit(make_train_step(lm_model, TCFG))
+        rng = jax.random.PRNGKey(42)
+        want = []
+        for src, tgt in batches:
+            state, m = step(state, src, tgt, rng)
+            want.append(float(m["loss"]))
+
+        mesh = make_mesh(MeshConfig(data=4, fsdp=2))
+        sstate, shardings = create_sharded_state(
+            jax.random.PRNGKey(0), lm_model, TCFG, mesh
+        )
+        train_step, _ = make_sharded_steps(
+            mesh, lm_model, TCFG, shardings, donate=False
+        )
+        got = []
+        for src, tgt in batches:
+            sstate, m = train_step(
+                sstate, put_batch(src, mesh), put_batch(tgt, mesh), rng
+            )
+            got.append(float(m["loss"]))
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
     def test_bucketed_widths_through_distributed_trainer(self):
         """Length-bucketed batches (two static widths) must run through the
         sharded trainer — one compile per width, same mesh."""

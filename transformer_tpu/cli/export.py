@@ -1,0 +1,68 @@
+"""Convert a training checkpoint into a serving export.
+
+    python -m transformer_tpu.cli.export --ckpt_path=model_dist \
+        --export_path=model --num_layers=6 --d_model=512 ... [--step=N]
+
+Training already exports at end-of-run (the reference's
+``tf.saved_model.save`` moment, ``train.py:246``); this tool covers the
+other case — exporting from a mid-run or crashed run's rotated checkpoints.
+Model-shape flags must match the training run (the checkpoint stores arrays
+keyed by the parameter tree, which the flags reconstruct); vocabulary sizes
+are recovered from the saved vocab files.
+"""
+
+from __future__ import annotations
+
+from absl import app, flags, logging
+
+from transformer_tpu.cli.flags import define_flags, flags_to_model_config, flags_to_train_config
+
+FLAGS = flags.FLAGS
+
+
+def define_export_flags() -> None:
+    define_flags()
+    flags.DEFINE_string("export_path", "model", "output directory")
+    flags.DEFINE_integer("step", 0, "checkpoint step to export (0 = latest)")
+
+
+def main(argv) -> None:
+    del argv
+    import jax
+
+    jax.config.update("jax_platforms", FLAGS.platform or "cpu")
+
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.train import CheckpointManager, create_train_state
+    from transformer_tpu.train.checkpoint import export_params
+
+    src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
+    tgt_tok = (
+        src_tok
+        if FLAGS.tgt_vocab_file == FLAGS.src_vocab_file
+        else SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+    )
+    model_cfg = flags_to_model_config(
+        src_tok.model_vocab_size, tgt_tok.model_vocab_size
+    )
+    template = create_train_state(
+        jax.random.PRNGKey(0), model_cfg, flags_to_train_config()
+    )
+    mgr = CheckpointManager(FLAGS.ckpt_path, FLAGS.max_ckpt_keep)
+    step = FLAGS.step or mgr.latest_step
+    if step is None:
+        raise app.UsageError(f"no checkpoints under {FLAGS.ckpt_path!r}")
+    state = mgr.restore(template, step)
+    export_params(state.params, model_cfg, FLAGS.export_path)
+    logging.info(
+        "exported step %d from %s to %s", step, FLAGS.ckpt_path, FLAGS.export_path
+    )
+
+
+def run() -> None:
+    define_export_flags()
+    app.run(main)
+
+
+if __name__ == "__main__":
+    run()

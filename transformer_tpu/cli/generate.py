@@ -1,0 +1,71 @@
+"""LM serving entry point: load a decoder-only export and continue prompts.
+
+    python -m transformer_tpu.cli.generate --export_path=model \
+        --vocab_file=tgt_vocab.subwords [--prompts="der Mann"] \
+        [--temperature=0.8 --top_k=40]      # or read stdin, one per line
+
+Counterpart of cli.translate for the causal-LM model family (BASELINE
+configs[4]); greedy by default, temperature/top-k sampling optional.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from absl import app, flags, logging
+
+FLAGS = flags.FLAGS
+
+
+def define_generate_flags() -> None:
+    flags.DEFINE_string("export_path", "model", "directory written by export_params")
+    flags.DEFINE_string("vocab_file", "tgt_vocab.subwords", "subword vocab path")
+    flags.DEFINE_string("prompts", "", "';'-separated prompts (default: stdin lines)")
+    flags.DEFINE_integer("max_new", 64, "max generated tokens per prompt")
+    flags.DEFINE_float("temperature", 0.0, "sampling temperature (0 = greedy)")
+    flags.DEFINE_integer("top_k", 0, "top-k truncation for sampling (0 = off)")
+    flags.DEFINE_integer("seed", 0, "sampling seed")
+    flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+
+
+def main(argv) -> None:
+    del argv
+    if FLAGS.platform:
+        import jax
+
+        jax.config.update("jax_platforms", FLAGS.platform)
+
+    from transformer_tpu.cli.translate import load_export
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.train.decode import generate
+
+    params, model_cfg = load_export(FLAGS.export_path)
+    if not model_cfg.decoder_only:
+        raise app.UsageError(
+            "the export is a seq2seq model; use cli.translate instead"
+        )
+    tok = SubwordTokenizer.load(FLAGS.vocab_file)
+
+    if FLAGS.prompts:
+        prompts = [p.strip() for p in FLAGS.prompts.split(";") if p.strip()]
+    else:
+        prompts = [line.strip() for line in sys.stdin if line.strip()]
+    if not prompts:
+        logging.warning("no input prompts")
+        return
+    outputs = generate(
+        params, model_cfg, tok, prompts,
+        max_new=FLAGS.max_new, temperature=FLAGS.temperature,
+        top_k=FLAGS.top_k, seed=FLAGS.seed,
+    )
+    for out in outputs:
+        print(out)
+
+
+def run() -> None:
+    define_generate_flags()
+    app.run(main)
+
+
+if __name__ == "__main__":
+    run()

@@ -69,6 +69,86 @@ def greedy_decode(
 
 @partial(
     jax.jit,
+    static_argnames=("cfg", "max_new", "eos_id", "sample", "top_k"),
+)
+def lm_generate(
+    params,
+    prompt_ids: jax.Array,
+    cfg: ModelConfig,
+    max_new: int,
+    eos_id: int,
+    rng: jax.Array | None = None,
+    sample: bool = False,
+    temperature: float | jax.Array = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Causal-LM continuation: (B, P) BOS-led prompt (PAD-right allowed) ->
+    (B, max_new) generated ids. The inference path for ``cfg.decoder_only``
+    models (the seq2seq entry point is ``greedy_decode``; no reference
+    counterpart — the reference is translation-only).
+
+    One compiled program: a single ``lax.scan`` walks prompt + generation
+    positions with per-layer KV caches; during the prompt it feeds the next
+    prompt token (prefill), afterwards the previous sample. ``sample=False``
+    is greedy argmax; ``sample=True`` draws from softmax(logits/temperature),
+    optionally truncated to the ``top_k`` highest-probability tokens.
+    ``temperature`` is a traced scalar — varying it does NOT recompile; only
+    the mode flag and ``top_k`` (a shape) are static.
+    """
+    batch, prompt_len = prompt_ids.shape
+    total = prompt_len + max_new
+    caches = init_decoder_caches(cfg, batch, total + 1)
+    prompt_lens = jnp.sum(prompt_ids != PAD_ID, axis=1, keepdims=True)  # (B,1)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if not sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / jnp.maximum(
+            jnp.asarray(temperature, jnp.float32), 1e-6
+        )
+        if top_k > 0:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, t):
+        tok, caches, finished = carry
+        logits, caches = transformer_decode_step(
+            params, tok, None, None, caches, t, cfg
+        )
+        sampled = pick(logits, jax.random.fold_in(rng, t))[:, None]
+        in_prompt = (t + 1) < prompt_lens  # next position still prompt?
+        nxt_prompt = jax.lax.dynamic_slice_in_dim(
+            prompt_ids, jnp.minimum(t + 1, prompt_len - 1), 1, axis=1
+        )
+        nxt = jnp.where(in_prompt, nxt_prompt, sampled)
+        nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
+        finished = jnp.logical_or(
+            finished, jnp.logical_and(~in_prompt, nxt == eos_id)
+        )
+        emitted = jnp.where(in_prompt, PAD_ID, nxt[:, :1])
+        return (nxt, caches, finished), emitted[:, 0]
+
+    init = (
+        prompt_ids[:, :1],
+        caches,
+        jnp.zeros((batch, 1), jnp.bool_),
+    )
+    _, toks = jax.lax.scan(
+        step, init, jnp.arange(total - 1, dtype=jnp.int32)
+    )
+    # toks[t] holds the token generated for position t+1; generation starts
+    # at each row's prompt_len. Gather each row's max_new generated tokens.
+    toks = toks.T  # (B, total-1)
+    cols = prompt_lens - 1 + jnp.arange(max_new)[None, :]  # (B, max_new)
+    cols = jnp.minimum(cols, total - 2)
+    return jnp.take_along_axis(toks, cols, axis=1)
+
+
+@partial(
+    jax.jit,
     static_argnames=("cfg", "max_len", "bos_id", "eos_id", "beam_size", "alpha"),
 )
 def beam_search_decode(
@@ -171,6 +251,66 @@ def beam_search_decode(
     )[:, 0, :]
 
 
+def _pad_batch(encoded: list[list[int]], width: int):
+    """Stack variable-length id lists into a PAD-canvas of power-of-two rows
+    (shared by ``translate`` and ``generate``); returns (ids, n_real_rows)."""
+    import numpy as np
+
+    n = len(encoded)
+    rows = _bucket(n, 1 << 30, floor=1)
+    ids = np.full((rows, width), PAD_ID, dtype=np.int32)
+    for i, e in enumerate(encoded):
+        ids[i, : min(len(e), width)] = e[:width]
+    return ids, n
+
+
+def _detokenize_rows(out, n: int, tokenizer) -> list[str]:
+    """Strip PAD/EOS from the first ``n`` rows and decode to text."""
+    texts = []
+    for row in out[:n]:
+        toks = [int(t) for t in row if t not in (PAD_ID, tokenizer.eos_id)]
+        texts.append(tokenizer.decode(toks))
+    return texts
+
+
+def generate(
+    params,
+    cfg: ModelConfig,
+    tokenizer,
+    prompts: str | list[str],
+    max_new: int = 64,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+) -> list[str]:
+    """Text-in/text-out continuation for ``cfg.decoder_only`` models: each
+    prompt is BOS-led (matching the LM training windows, ``data.pipeline.
+    make_lm_dataset``), generation stops per-row at EOS, output is
+    detokenized continuation text. Prompt widths bucket like ``translate``.
+    ``temperature`` 0 = greedy; > 0 samples (with optional top-k)."""
+    if not cfg.decoder_only:
+        raise ValueError("generate() is for decoder_only models; use translate()")
+    if isinstance(prompts, str):
+        prompts = [prompts]
+    encoded = [[tokenizer.bos_id, *tokenizer.encode(p)] for p in prompts]
+    longest = max(len(e) for e in encoded)
+    if longest + max_new > cfg.max_position:
+        raise ValueError(
+            f"prompt ({longest}) + max_new ({max_new}) exceeds max_position "
+            f"{cfg.max_position}"
+        )
+    width = _bucket(longest, cfg.max_position, floor=8)
+    ids, n = _pad_batch(encoded, width)
+    out = jax.device_get(
+        lm_generate(
+            params, jnp.asarray(ids), cfg, max_new, tokenizer.eos_id,
+            rng=jax.random.PRNGKey(seed),
+            sample=temperature > 0.0, temperature=temperature, top_k=top_k,
+        )
+    )
+    return _detokenize_rows(out, n, tokenizer)
+
+
 def _bucket(n: int, cap: int, floor: int = 16) -> int:
     """Round ``n`` up to a power of two, clamped to [floor, cap].
 
@@ -210,8 +350,6 @@ def translate(
     """
     if isinstance(sentences, str):
         sentences = [sentences]
-    import numpy as np
-
     encoded = [
         [src_tokenizer.bos_id, *src_tokenizer.encode(s), src_tokenizer.eos_id]
         for s in sentences
@@ -224,17 +362,13 @@ def translate(
             "into truncation (truncate=True / src_len=...)"
         )
     width = src_len or _bucket(longest, cfg.max_position)
-    n = len(encoded)
-    # Row bucket is pow2 with no cap (compile count stays logarithmic in the
-    # largest batch ever seen); pad rows are all-PAD and sliced off below.
-    rows = _bucket(n, 1 << 30, floor=1)
-    src = np.full((rows, width), PAD_ID, dtype=np.int32)
-    for i, e in enumerate(encoded):
-        if len(e) > width:
-            # Truncation was opted into: keep the source well-formed by
-            # terminating the clipped sequence with EOS.
-            e = [*e[: width - 1], src_tokenizer.eos_id]
-        src[i, : len(e)] = e
+    # Truncation was opted into (truncate=True / src_len): keep clipped
+    # sources well-formed by terminating them with EOS.
+    encoded = [
+        e if len(e) <= width else [*e[: width - 1], src_tokenizer.eos_id]
+        for e in encoded
+    ]
+    src, n = _pad_batch(encoded, width)
     if beam_size > 1:
         out = jax.device_get(
             beam_search_decode(
@@ -250,8 +384,4 @@ def translate(
                 tgt_tokenizer.bos_id, tgt_tokenizer.eos_id,
             )
         )
-    texts = []
-    for row in out[:n]:
-        ids = [int(t) for t in row if t not in (PAD_ID, tgt_tokenizer.eos_id)]
-        texts.append(tgt_tokenizer.decode(ids))
-    return texts
+    return _detokenize_rows(out, n, tgt_tokenizer)
