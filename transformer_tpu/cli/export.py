@@ -36,12 +36,17 @@ def main(argv) -> None:
     from transformer_tpu.train import CheckpointManager, create_train_state
     from transformer_tpu.train.checkpoint import export_params
 
-    src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
-    tgt_tok = (
-        src_tok
-        if FLAGS.tgt_vocab_file == FLAGS.src_vocab_file
-        else SubwordTokenizer.load(FLAGS.tgt_vocab_file)
-    )
+    if FLAGS.decoder_only:
+        # LM training builds only the target-side vocab (load_lm_splits);
+        # a decoder-only model has no encoder, so the src size is unused.
+        src_tok = tgt_tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+    else:
+        src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
+        tgt_tok = (
+            src_tok
+            if FLAGS.tgt_vocab_file == FLAGS.src_vocab_file
+            else SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+        )
     model_cfg = flags_to_model_config(
         src_tok.model_vocab_size, tgt_tok.model_vocab_size
     )

@@ -6,7 +6,9 @@ from transformer_tpu.data.tokenizer import SubwordTokenizer
 from transformer_tpu.data.pipeline import (
     Seq2SeqDataset,
     load_dataset,
+    load_lm_splits,
     load_or_build_tokenizer,
+    make_lm_dataset,
     read_parallel_corpus,
 )
 
@@ -14,6 +16,8 @@ __all__ = [
     "Seq2SeqDataset",
     "SubwordTokenizer",
     "load_dataset",
+    "load_lm_splits",
     "load_or_build_tokenizer",
+    "make_lm_dataset",
     "read_parallel_corpus",
 ]

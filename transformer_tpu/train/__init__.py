@@ -5,18 +5,36 @@ from transformer_tpu.train.schedule import noam_schedule
 from transformer_tpu.train.loss import masked_cross_entropy
 from transformer_tpu.train.state import TrainState, create_train_state, make_optimizer
 from transformer_tpu.train.trainer import Trainer, make_eval_step, make_train_step
-from transformer_tpu.train.checkpoint import CheckpointManager
-from transformer_tpu.train.decode import greedy_decode
+from transformer_tpu.train.checkpoint import (
+    CheckpointManager,
+    export_params,
+    load_exported_params,
+)
+from transformer_tpu.train.decode import (
+    beam_search_decode,
+    generate,
+    greedy_decode,
+    lm_generate,
+    translate,
+)
+from transformer_tpu.train.evaluate import bleu_on_pairs
 
 __all__ = [
     "CheckpointManager",
     "TrainState",
     "Trainer",
+    "beam_search_decode",
+    "bleu_on_pairs",
     "create_train_state",
+    "export_params",
+    "generate",
     "greedy_decode",
+    "lm_generate",
+    "load_exported_params",
     "make_eval_step",
     "make_optimizer",
     "make_train_step",
     "masked_cross_entropy",
     "noam_schedule",
+    "translate",
 ]

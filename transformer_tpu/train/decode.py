@@ -294,11 +294,14 @@ def generate(
         prompts = [prompts]
     encoded = [[tokenizer.bos_id, *tokenizer.encode(p)] for p in prompts]
     longest = max(len(e) for e in encoded)
-    if longest + max_new > cfg.max_position:
+    if longest >= cfg.max_position:
         raise ValueError(
-            f"prompt ({longest}) + max_new ({max_new}) exceeds max_position "
-            f"{cfg.max_position}"
+            f"a prompt encodes to {longest} tokens but the model's "
+            f"max_position is {cfg.max_position}; shorten the prompt"
         )
+    # The position budget caps generation: clamp rather than raise so the
+    # default max_new works for any model (standard generation semantics).
+    max_new = min(max_new, cfg.max_position - longest)
     width = _bucket(longest, cfg.max_position, floor=8)
     ids, n = _pad_batch(encoded, width)
     out = jax.device_get(
