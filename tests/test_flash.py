@@ -166,6 +166,30 @@ class TestBackward:
         for g, w in zip(got, want):
             np.testing.assert_allclose(g, w, atol=5e-5)
 
+    def test_grads_bf16_match_xla(self, rng):
+        """The bf16 training path (matmul inputs stay bf16, fp32 accum):
+        kernel gradients must track the XLA-attention gradients at bf16
+        tolerance — guards the backward-pass casts, not just the forward."""
+        q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(rng, s=32))
+
+        def f_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        def f_xla(q, k, v):
+            mask = jnp.tril(jnp.ones((32, 32), bool))[None, None]
+            out, _ = dot_product_attention(q, k, v, mask)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            assert g.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(g, np.float32), np.asarray(w, np.float32),
+                atol=0.15, rtol=0.15,
+            )
+
 
 class TestModelIntegration:
     """attention_impl='flash' must be a drop-in swap for 'xla'."""

@@ -71,6 +71,51 @@ class TestSequenceParallelAttention:
             np.testing.assert_allclose(g, w, atol=2e-5)
 
 
+def test_ring_bf16_matches_full_attention(seq_mesh):
+    """bf16 inputs (the TPU training dtype): ring must agree with plain
+    attention at bf16 tolerance — inputs feed the MXU in bf16, accumulation
+    stays fp32 (the flash kernel's numerics)."""
+    rng = np.random.default_rng(3)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, 64, 8, 16)), jnp.bfloat16)  # noqa: E731
+    q, k, v = mk(), mk(), mk()
+    fn = make_sequence_parallel_attention(seq_mesh, impl="ring")
+    got = fn(q, k, v, causal=True)
+    want, _ = dot_product_attention(
+        q, k, v, jnp.tril(jnp.ones((64, 64), bool))[None, None]
+    )
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_ring_bf16_grads_match_full_attention(seq_mesh):
+    """bf16 backward: gradients through the ring (bf16 matmul inputs, fp32
+    accumulation, p cast before the PV dot) must track the full-attention
+    gradients at bf16 tolerance."""
+    rng = np.random.default_rng(5)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, 64, 8, 16)), jnp.bfloat16)  # noqa: E731
+    q, k, v = mk(), mk(), mk()
+    fn = make_sequence_parallel_attention(seq_mesh, impl="ring")
+
+    def f_sp(q, k, v):
+        return (fn(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+
+    def f_ref(q, k, v):
+        mask = jnp.tril(jnp.ones((64, 64), bool))[None, None]
+        out, _ = dot_product_attention(q, k, v, mask)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    got = jax.grad(f_sp, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            atol=0.15, rtol=0.15,
+        )
+
+
 def test_ulysses_rejects_indivisible_heads(seq_mesh):
     """8-way seq axis cannot split 6 heads."""
     fn = make_sequence_parallel_attention(seq_mesh, impl="ulysses")

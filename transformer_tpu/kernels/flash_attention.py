@@ -136,11 +136,18 @@ def _fwd_kernel(cfg: _FlashConfig, *refs):
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * cfg.scale  # (bq, D)
-        k = k_ref[0].astype(jnp.float32)  # (bk, D)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
+        # Matmul inputs stay in the model dtype (bf16 runs the MXU at full
+        # rate; fp32 inputs don't) with fp32 accumulation; scale applies to
+        # the fp32 scores. For fp32 models every cast below is a no-op.
+        q = q_ref[0]  # (bq, D)
+        k = k_ref[0]  # (bk, D)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * cfg.scale
+        )  # (bq, bk) fp32
         s = _tile_bias(cfg, s, i, j, mask_ref)
 
         m_prev = m_scr[:, 0:1]  # (bq, 1)
@@ -150,9 +157,10 @@ def _fwd_kernel(cfg: _FlashConfig, *refs):
         p = jnp.where(s > _MASK_GUARD, jnp.exp(s - m_new), 0.0)  # (bq, bk)
         correction = jnp.exp(m_prev - m_new)  # (bq, 1)
         l_new = correction * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0].astype(jnp.float32)  # (bk, D)
+        v = v_ref[0]  # (bk, D)
         acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -223,11 +231,17 @@ def _fwd(cfg: _FlashConfig, q, k, v, kv_mask):
 
 
 def _recompute_p(cfg: _FlashConfig, q_ref, k_ref, lse_ref, mask_ref, i, j):
-    """Recompute the (bq, bk) probability tile from the saved logsumexp."""
-    q = q_ref[0].astype(jnp.float32) * cfg.scale
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    """Recompute the (bq, bk) probability tile from the saved logsumexp.
+    q/k are returned in their stored (model) dtype; scale is folded into the
+    fp32 score tensor, so callers contracting against q must scale ds."""
+    q = q_ref[0]
+    k = k_ref[0]
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * cfg.scale
     )
     s = _tile_bias(cfg, s, i, j, mask_ref)
     lse = lse_ref[0, 0]  # (bq, 1) column — broadcasts along lanes
@@ -252,14 +266,15 @@ def _dq_kernel(cfg: _FlashConfig, *refs):
 
     def _compute():
         _, k, p = _recompute_p(cfg, q_ref, k_ref, lse_ref, mask_ref, i, j)
-        do = do_ref[0].astype(jnp.float32)  # (bq, D)
-        v = v_ref[0].astype(jnp.float32)  # (bk, D)
+        do = do_ref[0]  # (bq, D)
+        v = v_ref[0]  # (bk, D)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
         ds = p * (dp - delta_ref[0, 0])  # delta: (bq, 1) column
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     if cfg.causal:
@@ -291,19 +306,22 @@ def _dkdv_kernel(cfg: _FlashConfig, *refs):
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        q_scaled, _, p = _recompute_p(cfg, q_ref, k_ref, lse_ref, mask_ref, i, j)
-        do = do_ref[0].astype(jnp.float32)  # (bq, D)
-        v = v_ref[0].astype(jnp.float32)  # (bk, D)
+        q, _, p = _recompute_p(cfg, q_ref, k_ref, lse_ref, mask_ref, i, j)
+        do = do_ref[0]  # (bq, D)
+        v = v_ref[0]  # (bk, D)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # pᵀ·do -> (bk, D)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_ref[0, 0])
+        # s = scale·(q·kᵀ): the scale that used to ride on q folds into ds.
         dk_scr[:] += jax.lax.dot_general(
-            ds, q_scaled, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # dsᵀ·(q·scale) -> (bk, D)
+            (ds * cfg.scale).astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (ds·scale)ᵀ·q -> (bk, D)
 
     if cfg.causal:
         pl.when(_visible(cfg, i, j))(_compute)

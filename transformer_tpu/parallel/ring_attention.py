@@ -67,7 +67,10 @@ def ring_attention(
     b, c, h, d = q.shape
     my_idx = jax.lax.axis_index(axis_name)
     scale = d**-0.5
-    qf = q.astype(jnp.float32).transpose(0, 2, 1, 3) * scale  # (B, H, C, D)
+    # Matmul INPUTS stay in the model dtype (bf16 feeds the MXU at full
+    # rate; fp32 inputs run at 1/8 throughput) and ACCUMULATE in fp32 via
+    # preferred_element_type — the flash kernel's numerics.
+    qt = q.transpose(0, 2, 1, 3)  # (B, H, C, D)
 
     m = jnp.full((b, h, c, 1), _MASKED, jnp.float32)
     l = jnp.zeros((b, h, c, 1), jnp.float32)
@@ -82,9 +85,15 @@ def ring_attention(
 
     for t in range(axis_size):
         src = (my_idx - t) % axis_size  # which global chunk we hold this step
-        kf = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B, H, C, D)
-        vf = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)  # (B, H, C, C)
+        kt = k_cur.transpose(0, 2, 1, 3)  # (B, H, C, D)
+        vt = v_cur.transpose(0, 2, 1, 3)
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk", qt, kt,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (B, H, C, C) fp32
         if mask_cur is not None:
             s = jnp.where(mask_cur[:, None, None, :], s, _MASKED)
         if causal:
@@ -97,7 +106,10 @@ def ring_attention(
         p = jnp.where(s > _MASK_GUARD, jnp.exp(s - m_new), 0.0)
         correction = jnp.exp(m - m_new)
         l = correction * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * correction + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        acc = acc * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(q.dtype), vt,
+            preferred_element_type=jnp.float32,
+        )
         m = m_new
         if t + 1 < axis_size:
             k_cur = jax.lax.ppermute(k_cur, axis_name, shift)
