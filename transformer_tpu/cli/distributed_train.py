@@ -109,16 +109,42 @@ def main(argv) -> None:
     )
     trainer.fit(train_ds, test_ds)
 
+    # Multi-host: params are sharded across processes, but the epilogue
+    # (sample decode, export, BLEU) runs on host 0 alone — device_get/jit on
+    # arrays with non-addressable shards would fail or deadlock. Gather to
+    # host-local numpy on EVERY process (allgather is a collective), then
+    # let host 0 proceed.
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        host_params = multihost_utils.process_allgather(trainer.state.params)
+    else:
+        host_params = trainer.state.params
+
     if jax.process_index() == 0:
         if not FLAGS.decoder_only:
             sample = ["he goes to school"]
             out = translate(
-                trainer.state.params, model_cfg, src_tok, tgt_tok, sample,
+                host_params, model_cfg, src_tok, tgt_tok, sample,
                 max_len=train_cfg.sequence_length,
             )
             logging.info("sample translation %r -> %r", sample[0], out[0])
-        export_params(trainer.state.params, model_cfg, "model")
+        export_params(host_params, model_cfg, "model")
         logging.info("exported params to ./model")
+
+        # End-of-run BLEU on the test split (same epilogue as cli.train so
+        # both entry points report the north-star metric).
+        if FLAGS.eval_bleu and not FLAGS.decoder_only:
+            from transformer_tpu.train.evaluate import bleu_on_test_files
+
+            bleu_on_test_files(
+                host_params, model_cfg, src_tok, tgt_tok,
+                FLAGS.dataset_path,
+                batch_size=train_cfg.batch_size,
+                max_len=train_cfg.sequence_length,
+                limit=FLAGS.bleu_limit,
+                log_fn=logging.info,
+            )
 
 
 def run() -> None:

@@ -127,32 +127,16 @@ def main(argv) -> None:
     # the test split, when one exists. The reference never computes any
     # translation-quality metric (token accuracy only, train.py:140-141).
     if FLAGS.eval_bleu and not FLAGS.decoder_only:
-        import glob as _glob
+        from transformer_tpu.train.evaluate import bleu_on_test_files
 
-        src_tests = sorted(
-            _glob.glob(os.path.join(FLAGS.dataset_path, "src-test*.txt"))
+        bleu_on_test_files(
+            trainer.state.params, model_cfg, src_tok, tgt_tok,
+            FLAGS.dataset_path,
+            batch_size=train_cfg.batch_size,
+            max_len=train_cfg.sequence_length,
+            limit=FLAGS.bleu_limit,
+            log_fn=logging.info,
         )
-        tgt_tests = sorted(
-            _glob.glob(os.path.join(FLAGS.dataset_path, "tgt-test*.txt"))
-        )
-        if src_tests and tgt_tests:
-            from transformer_tpu.train.evaluate import bleu_on_pairs, read_lines
-
-            src_lines = [l for p in src_tests for l in read_lines(p)]
-            ref_lines = [l for p in tgt_tests for l in read_lines(p)]
-            if FLAGS.bleu_limit:
-                src_lines = src_lines[: FLAGS.bleu_limit]
-                ref_lines = ref_lines[: FLAGS.bleu_limit]
-            bleu, _ = bleu_on_pairs(
-                trainer.state.params, model_cfg, src_tok, tgt_tok,
-                src_lines, ref_lines,
-                batch_size=train_cfg.batch_size,
-                max_len=train_cfg.sequence_length,
-                log_fn=logging.info,
-            )
-            logging.info("test BLEU %.2f on %d pairs", bleu, len(src_lines))
-        else:
-            logging.info("no test split under %s; skipping BLEU", FLAGS.dataset_path)
 
 
 def run() -> None:

@@ -12,6 +12,7 @@ publishes the number in BASELINE.md).
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 from transformer_tpu.config import ModelConfig
@@ -59,3 +60,40 @@ def bleu_on_pairs(
 def read_lines(path: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         return [line.rstrip("\n") for line in f]
+
+
+def bleu_on_test_files(
+    params,
+    model_cfg: ModelConfig,
+    src_tok,
+    tgt_tok,
+    dataset_path: str,
+    *,
+    batch_size: int = 64,
+    max_len: int = 64,
+    limit: int = 0,
+    log_fn: Callable[[str], None] | None = None,
+) -> tuple[float, int] | None:
+    """Score the ``{src,tgt}-test*.txt`` split under ``dataset_path`` —
+    the shared end-of-run BLEU epilogue of both training CLIs. Returns
+    (bleu, n_pairs), or None when no test split exists."""
+    import glob
+
+    src_tests = sorted(glob.glob(os.path.join(dataset_path, "src-test*.txt")))
+    tgt_tests = sorted(glob.glob(os.path.join(dataset_path, "tgt-test*.txt")))
+    if not src_tests or not tgt_tests:
+        if log_fn is not None:
+            log_fn(f"no test split under {dataset_path}; skipping BLEU")
+        return None
+    src_lines = [l for p in src_tests for l in read_lines(p)]
+    ref_lines = [l for p in tgt_tests for l in read_lines(p)]
+    if limit:
+        src_lines = src_lines[:limit]
+        ref_lines = ref_lines[:limit]
+    bleu, _ = bleu_on_pairs(
+        params, model_cfg, src_tok, tgt_tok, src_lines, ref_lines,
+        batch_size=batch_size, max_len=max_len, log_fn=log_fn,
+    )
+    if log_fn is not None:
+        log_fn(f"test BLEU {bleu:.2f} on {len(src_lines)} pairs")
+    return bleu, len(src_lines)
