@@ -41,8 +41,33 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--vocab", type=int, default=2**15)
     ap.add_argument("--bleu_max_len", type=int, default=64)
-    ap.add_argument("--workdir", default="/tmp/bleu_run")
+    ap.add_argument(
+        "--workdir", default="",
+        help="vocab/checkpoint directory; default derives from the run "
+        "parameters so different corpora/configs never share stale vocabs "
+        "or restore each other's checkpoints",
+    )
+    ap.add_argument(
+        "--data_dir", default=os.path.join(REPO, "data"),
+        help="corpus directory (override for smoke tests on subsets)",
+    )
     args = ap.parse_args()
+    if not args.workdir:
+        import hashlib
+
+        key = hashlib.md5(
+            f"{os.path.abspath(args.data_dir)}|{args.config}|{args.vocab}|"
+            f"{args.seq_len}".encode()
+        ).hexdigest()[:10]
+        args.workdir = f"/tmp/bleu_run_{key}"
+    # Fail before training, not after: the scoring split must exist.
+    for name in ("src-test.txt", "tgt-test.txt"):
+        path = os.path.join(args.data_dir, name)
+        if not os.path.exists(path):
+            raise SystemExit(
+                f"missing {path}: the BLEU run needs a test split "
+                "(data/README.md describes the bundled one)"
+            )
 
     import jax
 
@@ -60,7 +85,7 @@ def main() -> None:
     # three compiles.
     buckets = (24, 36, args.seq_len) if args.seq_len >= 48 else ()
     train_ds, test_ds, src_tok, tgt_tok = load_dataset(
-        os.path.join(REPO, "data"),
+        args.data_dir,
         os.path.join(args.workdir, "src_vocab.subwords"),
         os.path.join(args.workdir, "tgt_vocab.subwords"),
         batch_size=args.batch,
@@ -99,8 +124,8 @@ def main() -> None:
     trainer.fit(train_ds, test_ds)
     train_s = time.perf_counter() - t0
 
-    src_lines = read_lines(os.path.join(REPO, "data", "src-test.txt"))
-    ref_lines = read_lines(os.path.join(REPO, "data", "tgt-test.txt"))
+    src_lines = read_lines(os.path.join(args.data_dir, "src-test.txt"))
+    ref_lines = read_lines(os.path.join(args.data_dir, "tgt-test.txt"))
     t1 = time.perf_counter()
     bleu, hyps = bleu_on_pairs(
         trainer.state.params, model_cfg, src_tok, tgt_tok,

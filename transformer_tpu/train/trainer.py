@@ -390,9 +390,16 @@ class Trainer:
     def _write_epoch_summaries(self, epoch: int) -> None:
         if not self.writers:
             return
+        from transformer_tpu.train.schedule import noam_schedule
+
         w = self.writers["train"]
         w.scalar("loss", self.train_metrics.loss, epoch)
         w.scalar("accuracy", self.train_metrics.accuracy, epoch)
+        lr = noam_schedule(self.model_cfg.d_model, self.train_cfg.warmup_steps)(
+            int(jax.device_get(self.state.step))
+        )
+        w.scalar("learning_rate", float(lr), epoch)
+        w.scalar("tokens_per_sec", self.step_timer.tokens_per_sec, epoch)
         w.flush()
         if self.eval_metrics.weight > 0:
             w = self.writers["test"]
