@@ -42,6 +42,11 @@ def main() -> None:
     ap.add_argument("--vocab", type=int, default=2**15)
     ap.add_argument("--bleu_max_len", type=int, default=64)
     ap.add_argument(
+        "--bleu_every", type=int, default=0,
+        help="also score a 64-pair BLEU probe every N epochs during "
+        "training (0 = end-of-run only)",
+    )
+    ap.add_argument(
         "--workdir", default="",
         help="vocab/checkpoint directory; default derives from the run "
         "parameters so different corpora/configs never share stale vocabs "
@@ -55,9 +60,13 @@ def main() -> None:
     if not args.workdir:
         import hashlib
 
+        # Every training-relevant knob is in the key: a rerun with ANY
+        # different parameter gets a fresh dir, so restore-before-train can
+        # only ever resume an identical interrupted run — never silently
+        # continue a different one and misreport "epochs".
         key = hashlib.md5(
             f"{os.path.abspath(args.data_dir)}|{args.config}|{args.vocab}|"
-            f"{args.seq_len}".encode()
+            f"{args.seq_len}|{args.epochs}|{args.warmup}|{args.batch}".encode()
         ).hexdigest()[:10]
         args.workdir = f"/tmp/bleu_run_{key}"
     # Fail before training, not after: the scoring split must exist.
@@ -120,12 +129,27 @@ def main() -> None:
         checkpoint=CheckpointManager(train_cfg.ckpt_path, 2),
         log_fn=lambda msg: print(msg, file=sys.stderr),
     )
-    t0 = time.perf_counter()
-    trainer.fit(train_ds, test_ds)
-    train_s = time.perf_counter() - t0
-
     src_lines = read_lines(os.path.join(args.data_dir, "src-test.txt"))
     ref_lines = read_lines(os.path.join(args.data_dir, "tgt-test.txt"))
+
+    callback = None
+    probe_s = [0.0]  # probe decode time (incl. its compile) is NOT training
+    if args.bleu_every:
+        def callback(epoch, tr):
+            if (epoch + 1) % args.bleu_every:
+                return
+            t = time.perf_counter()
+            probe, _ = bleu_on_pairs(
+                tr.state.params, model_cfg, src_tok, tgt_tok,
+                src_lines[:64], ref_lines[:64],
+                batch_size=args.batch, max_len=args.bleu_max_len,
+            )
+            probe_s[0] += time.perf_counter() - t
+            print(f"epoch {epoch + 1}: probe BLEU {probe:.2f}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    trainer.fit(train_ds, test_ds, epoch_callback=callback)
+    train_s = time.perf_counter() - t0 - probe_s[0]
     t1 = time.perf_counter()
     bleu, hyps = bleu_on_pairs(
         trainer.state.params, model_cfg, src_tok, tgt_tok,

@@ -28,11 +28,18 @@ class TrainState:
     opt_state: Any
 
 
+def make_lr_schedule(model_cfg: ModelConfig, train_cfg: TrainConfig):
+    """THE learning-rate schedule — single definition shared by the optimizer
+    and observability (TensorBoard's learning_rate scalar), so the plotted
+    curve can never drift from the one actually applied."""
+    return noam_schedule(model_cfg.d_model, train_cfg.warmup_steps)
+
+
 def make_optimizer(model_cfg: ModelConfig, train_cfg: TrainConfig) -> optax.GradientTransformation:
     """Adam(β1=0.9, β2=0.98, ε=1e-9) under the noam schedule — the reference's
     optimizer exactly (``train.py:65-66``), plus optional global-norm clipping
     (absent from the reference; off by default)."""
-    schedule = noam_schedule(model_cfg.d_model, train_cfg.warmup_steps)
+    schedule = make_lr_schedule(model_cfg, train_cfg)
     tx = optax.adam(
         learning_rate=schedule,
         b1=train_cfg.adam_beta1,

@@ -287,7 +287,17 @@ class Trainer:
             m = self.eval_step(self.state, src, tgt)
             self.eval_metrics.update(m)
 
-    def fit(self, train_ds, test_ds=None, rng: jax.Array | None = None) -> None:
+    def fit(
+        self,
+        train_ds,
+        test_ds=None,
+        rng: jax.Array | None = None,
+        epoch_callback: Callable[[int, "Trainer"], None] | None = None,
+    ) -> None:
+        """``epoch_callback(epoch, trainer)``, if given, runs after each
+        epoch's metrics/eval/summaries and before the checkpoint save —
+        the hook for in-training quality tracking (e.g. periodic BLEU in
+        ``benchmarks/bleu_run.py``)."""
         cfg = self.train_cfg
         rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
         # Restore BEFORE training (fixes reference restore-after, train.py:242-243).
@@ -300,8 +310,24 @@ class Trainer:
         # Host-side step mirror: consulting state.step (a device array) every
         # iteration would block async dispatch.
         step = int(self.state.step)
+        # Resume at the right EPOCH, not just the right step: a restored run
+        # must train only the remaining epochs (and continue the (seed,
+        # epoch)-keyed data order), not cfg.epochs more. Possible only when
+        # the dataset advertises its per-epoch length.
+        start_epoch = 0
+        try:
+            steps_per_epoch = len(train_ds)
+        except TypeError:
+            steps_per_epoch = 0
+        if step and steps_per_epoch:
+            start_epoch = min(step // steps_per_epoch, cfg.epochs)
+            if start_epoch:
+                self.log_fn(
+                    f"resuming at epoch {start_epoch + 1}/{cfg.epochs} "
+                    f"(step {step})"
+                )
         with PreemptionGuard() as guard:
-            for epoch in range(cfg.epochs):
+            for epoch in range(start_epoch, cfg.epochs):
                 self.train_metrics.reset()
                 self.step_timer.reset()
                 epoch_start = time.time()
@@ -362,6 +388,8 @@ class Trainer:
                     f"acc {self.train_metrics.accuracy:.4f}; "
                     f"{self.step_timer.summary()}"
                 )
+                if epoch_callback is not None:
+                    epoch_callback(epoch, self)
                 if self.checkpoint is not None and (
                     (epoch + 1) % cfg.checkpoint_every_epochs == 0
                     or (epoch + 1) == cfg.epochs
@@ -390,12 +418,12 @@ class Trainer:
     def _write_epoch_summaries(self, epoch: int) -> None:
         if not self.writers:
             return
-        from transformer_tpu.train.schedule import noam_schedule
+        from transformer_tpu.train.state import make_lr_schedule
 
         w = self.writers["train"]
         w.scalar("loss", self.train_metrics.loss, epoch)
         w.scalar("accuracy", self.train_metrics.accuracy, epoch)
-        lr = noam_schedule(self.model_cfg.d_model, self.train_cfg.warmup_steps)(
+        lr = make_lr_schedule(self.model_cfg, self.train_cfg)(
             int(jax.device_get(self.state.step))
         )
         w.scalar("learning_rate", float(lr), epoch)
