@@ -68,7 +68,9 @@ def _mesh_losses(mesh_cfg: MeshConfig, n_steps=4):
 class TestMesh:
     def test_make_mesh_shapes(self):
         mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
-        assert mesh.shape == {"data": 2, "fsdp": 2, "model": 2, "seq": 1, "pipe": 1}
+        assert mesh.shape == {
+            "data": 2, "fsdp": 2, "model": 2, "seq": 1, "pipe": 1, "expert": 1
+        }
 
     def test_device_count_mismatch_raises(self):
         with pytest.raises(ValueError):

@@ -61,7 +61,7 @@ class TestPipelineApply:
 
     def _sequential(self, params, x, mask):
         for layer in params["layers"]:
-            x, _ = encoder_layer_apply(layer, x, mask, CFG, None, True)
+            x, _, _ = encoder_layer_apply(layer, x, mask, CFG, None, True)
         return x
 
     @pytest.mark.parametrize("data,pipe,mbs", [(1, 4, 4), (2, 4, 2), (1, 2, 4), (1, 1, 2)])
@@ -99,7 +99,7 @@ class TestPipelineApply:
             h = x
             for i in range(CFG.num_layers):
                 lp = jax.tree.map(lambda a: a[i], s)
-                h, _ = encoder_layer_apply(lp, h, mask, CFG, None, True)
+                h, _, _ = encoder_layer_apply(lp, h, mask, CFG, None, True)
             return jnp.sum(h**2)
 
         g_pp = jax.jit(jax.grad(loss_pp))(stacked)

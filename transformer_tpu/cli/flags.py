@@ -56,6 +56,17 @@ def define_flags() -> None:
     flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring", "ulysses"],
                       "attention kernel (ring/ulysses = sequence-parallel, use with --sp>1)")
     flags.DEFINE_string("dtype", "bfloat16", "compute dtype")
+    flags.DEFINE_integer(
+        "moe_experts", 0,
+        "Mixture-of-Experts FFN: experts per MoE layer (0 = dense FFN). "
+        "Shard over devices with --ep.")
+    flags.DEFINE_integer("moe_top_k", 2, "experts each token routes to")
+    flags.DEFINE_float("moe_capacity_factor", 1.25,
+                       "slack over the even-split expert capacity")
+    flags.DEFINE_integer("moe_every", 1,
+                         "MoE cadence: every k-th layer carries the MoE FFN")
+    flags.DEFINE_float("moe_aux_weight", 0.01,
+                       "load-balance auxiliary loss weight")
     flags.DEFINE_boolean(
         "remat", False,
         "rematerialize layer activations in backward (less HBM, ~1/3 more "
@@ -83,6 +94,11 @@ def define_flags() -> None:
         "pipeline-parallel mesh size (GPipe stages). Note: pipe partitions "
         "compute only; combine with --fsdp to shard stage params/optimizer "
         "state, else each device holds a full param replica.")
+    flags.DEFINE_integer(
+        "ep", 1,
+        "expert-parallel mesh size (MoE expert weights sharded; tokens reach "
+        "their experts via an ICI all-to-all). The expert axis also splits "
+        "the batch, so it contributes to the data-parallel divisibility check.")
     flags.DEFINE_integer(
         "pp_microbatches", 0,
         "GPipe microbatches per step (0 = one per stage); more microbatches "
@@ -119,6 +135,11 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         dtype=FLAGS.dtype,
         attention_impl=FLAGS.attention_impl,
         remat=FLAGS.remat,
+        moe_experts=FLAGS.moe_experts,
+        moe_top_k=FLAGS.moe_top_k,
+        moe_capacity_factor=FLAGS.moe_capacity_factor,
+        moe_every=FLAGS.moe_every,
+        moe_aux_weight=FLAGS.moe_aux_weight,
     )
 
 
@@ -156,10 +177,11 @@ def flags_to_profiler():
 
 
 def flags_to_mesh_config(n_devices: int) -> MeshConfig:
-    non_dp = FLAGS.fsdp * FLAGS.tp * FLAGS.sp * FLAGS.pp
+    non_dp = FLAGS.fsdp * FLAGS.tp * FLAGS.sp * FLAGS.pp * FLAGS.ep
     dp = FLAGS.dp or max(1, n_devices // non_dp)
     return MeshConfig(
-        data=dp, fsdp=FLAGS.fsdp, model=FLAGS.tp, seq=FLAGS.sp, pipe=FLAGS.pp
+        data=dp, fsdp=FLAGS.fsdp, model=FLAGS.tp, seq=FLAGS.sp, pipe=FLAGS.pp,
+        expert=FLAGS.ep,
     )
 
 

@@ -66,6 +66,15 @@ class ModelConfig:
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(layers) less activation
     # HBM — the standard lever for long-context configs (BASELINE configs[4]).
     remat: bool = False
+    # Mixture-of-Experts FFN (capability extension; the reference's FFN is
+    # dense, ``point_ffn.py:3-7``). 0 = dense FFN everywhere. When > 0, every
+    # ``moe_every``-th layer replaces its FFN with a ``moe_experts``-expert
+    # MoE (``ops/moe.py``), sharded over the mesh's ``expert`` axis.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_every: int = 1  # 1 = every layer; 2 = every other layer (GShard style)
+    moe_aux_weight: float = 0.01  # load-balance loss weight in the objective
 
     def __post_init__(self) -> None:
         if self.d_model % self.num_heads != 0:
@@ -80,6 +89,16 @@ class ModelConfig:
             raise ValueError(f"unknown ffn_activation {self.ffn_activation!r}")
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.moe_experts < 0 or self.moe_top_k < 1 or self.moe_every < 1:
+            raise ValueError(
+                "moe_experts must be >= 0, moe_top_k and moe_every >= 1 "
+                f"(got {self.moe_experts}/{self.moe_top_k}/{self.moe_every})"
+            )
+        if self.moe_experts and self.moe_top_k > self.moe_experts:
+            raise ValueError(
+                f"moe_top_k ({self.moe_top_k}) cannot exceed moe_experts "
+                f"({self.moe_experts})"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -157,6 +176,8 @@ class MeshConfig:
       pipe axis partitions *compute*; combine with ``fsdp`` to also shard
       stage parameters/optimizer state, otherwise each device holds a full
       replica of the stacked layer params.
+    - ``expert``: expert parallelism (MoE expert weights sharded over ICI,
+      token slots all-to-all'd to their experts by GSPMD — ``ops/moe.py``).
     """
 
     data: int = 1
@@ -164,18 +185,19 @@ class MeshConfig:
     model: int = 1
     seq: int = 1
     pipe: int = 1
+    expert: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.model * self.seq * self.pipe
+        return self.data * self.fsdp * self.model * self.seq * self.pipe * self.expert
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("data", "fsdp", "model", "seq", "pipe")
+        return ("data", "fsdp", "model", "seq", "pipe", "expert")
 
     @property
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.model, self.seq, self.pipe)
+        return (self.data, self.fsdp, self.model, self.seq, self.pipe, self.expert)
 
 
 def config_to_json(cfg: Any) -> str:

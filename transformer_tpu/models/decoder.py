@@ -21,21 +21,29 @@ import jax
 
 from transformer_tpu.config import ModelConfig
 from transformer_tpu.ops.attention import init_cache, mha_apply, mha_init
-from transformer_tpu.ops.ffn import ffn_apply, ffn_init
 from transformer_tpu.ops.nn import (
     Params,
     embedding_init,
     layernorm_apply,
     layernorm_init,
 )
-from transformer_tpu.models.encoder import _sublayer, embed_prologue
+from transformer_tpu.models.encoder import (
+    _ffn_sublayer_apply,
+    _ffn_sublayer_init,
+    _sublayer,
+    _token_mask_from,
+    embed_prologue,
+    layer_uses_moe,
+)
 
 
-def decoder_layer_init(key: jax.Array, cfg: ModelConfig) -> Params:
+def decoder_layer_init(
+    key: jax.Array, cfg: ModelConfig, layer_index: int = 0
+) -> Params:
     k1, k2, k3 = jax.random.split(key, 3)
     params: Params = {
         "self_mha": mha_init(k1, cfg.d_model, cfg.num_heads, cfg.params_dtype),
-        "ffn": ffn_init(k3, cfg.d_model, cfg.dff, cfg.params_dtype),
+        **_ffn_sublayer_init(k3, cfg, layer_uses_moe(cfg, layer_index)),
         "ln1": layernorm_init(cfg.d_model, cfg.params_dtype),
         "ln_ffn": layernorm_init(cfg.d_model, cfg.params_dtype),
     }
@@ -57,14 +65,19 @@ def decoder_layer_apply(
     return_weights: bool = False,
     cache: dict[str, Any] | None = None,
     cross_kv: tuple[jax.Array, jax.Array] | None = None,
-) -> tuple[jax.Array, jax.Array | None, jax.Array | None, dict[str, Any] | None]:
-    """Returns (x, self_attn_weights, cross_attn_weights, updated_cache).
+) -> tuple[
+    jax.Array, jax.Array | None, jax.Array | None, dict[str, Any] | None, jax.Array | None
+]:
+    """Returns (x, self_attn_weights, cross_attn_weights, updated_cache,
+    moe_aux_loss) — the aux loss is None for dense-FFN layers (see
+    ``encoder_layer_apply``).
 
     ``cross_kv`` optionally carries this layer's pre-projected encoder K/V so
     decode steps don't re-project the static encoder output every token.
     """
     r1, r2, r3 = (None, None, None) if rng is None else jax.random.split(rng, 3)
     boxes: list[Any] = [None, None, None]
+    aux_box: list = [None]
 
     def self_attn(h):
         out, w, new_cache = mha_apply(
@@ -99,10 +112,12 @@ def decoder_layer_apply(
 
     x = _sublayer(
         cfg, params["ln_ffn"], x,
-        lambda h: ffn_apply(params["ffn"], h, cfg.ffn_activation),
+        lambda h: _ffn_sublayer_apply(
+            params, h, cfg, aux_box, _token_mask_from(self_mask)
+        ),
         r3, deterministic,
     )
-    return x, boxes[0], boxes[1], boxes[2]
+    return x, boxes[0], boxes[1], boxes[2], aux_box[0]
 
 
 def decoder_init(key: jax.Array, cfg: ModelConfig, embedding: Params | None = None) -> Params:
@@ -113,7 +128,7 @@ def decoder_init(key: jax.Array, cfg: ModelConfig, embedding: Params | None = No
         "embedding": embedding
         if embedding is not None
         else embedding_init(keys[0], cfg.target_vocab_size, cfg.d_model, cfg.params_dtype),
-        "layers": [decoder_layer_init(keys[i + 1], cfg) for i in range(cfg.num_layers)],
+        "layers": [decoder_layer_init(keys[i + 1], cfg, i) for i in range(cfg.num_layers)],
     }
     if cfg.norm_scheme == "pre":
         params["final_ln"] = layernorm_init(cfg.d_model, cfg.params_dtype)
@@ -147,6 +162,7 @@ def decoder_apply(
     )
     attn_weights: dict[str, jax.Array] = {}
     new_caches: list[dict[str, Any]] | None = [] if caches is not None else None
+    aux_total = None
 
     def layer_call(layer, x, enc_out, self_mask, cross_mask, r, cache, cross_kv):
         return decoder_layer_apply(
@@ -159,7 +175,7 @@ def decoder_apply(
         # recomputation); see cfg.remat docstring.
         layer_call = jax.checkpoint(layer_call)
     for i, layer in enumerate(params["layers"]):
-        x, w1, w2, new_cache = layer_call(
+        x, w1, w2, new_cache, aux = layer_call(
             layer, x, enc_out, self_mask, cross_mask, rngs[i + 1],
             None if caches is None else caches[i],
             None if cross_kvs is None else cross_kvs[i],
@@ -168,8 +184,12 @@ def decoder_apply(
             attn_weights[f"decoder_layer{i + 1}_block1"] = w1
         if w2 is not None:
             attn_weights[f"decoder_layer{i + 1}_block2"] = w2
+        if aux is not None:
+            aux_total = aux if aux_total is None else aux_total + aux
         if new_caches is not None:
             new_caches.append(new_cache)
+    if aux_total is not None:
+        attn_weights["moe_aux_decoder"] = aux_total
     if cfg.norm_scheme == "pre":
         x = layernorm_apply(params["final_ln"], x, cfg.layernorm_epsilon)
     return x, attn_weights, new_caches

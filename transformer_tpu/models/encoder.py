@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from transformer_tpu.config import ModelConfig
 from transformer_tpu.ops.attention import mha_apply, mha_init
 from transformer_tpu.ops.ffn import ffn_apply, ffn_init
+from transformer_tpu.ops.moe import moe_apply, moe_init
 from transformer_tpu.ops.nn import (
     Params,
     dropout,
@@ -31,11 +32,62 @@ from transformer_tpu.ops.nn import (
 from transformer_tpu.ops.positional import sinusoidal_positional_encoding
 
 
-def encoder_layer_init(key: jax.Array, cfg: ModelConfig) -> Params:
+def layer_uses_moe(cfg: ModelConfig, layer_index: int) -> bool:
+    """Whether layer ``layer_index`` (0-based) carries a MoE FFN: every
+    ``moe_every``-th layer counting from the top of the cadence (GShard
+    alternates, Switch uses every layer — ``cfg.moe_every`` choses)."""
+    return cfg.moe_experts > 0 and (layer_index + 1) % cfg.moe_every == 0
+
+
+def _ffn_sublayer_init(key: jax.Array, cfg: ModelConfig, use_moe: bool) -> dict:
+    if use_moe:
+        return {
+            "moe": moe_init(
+                key, cfg.d_model, cfg.dff, cfg.moe_experts, cfg.params_dtype
+            )
+        }
+    return {"ffn": ffn_init(key, cfg.d_model, cfg.dff, cfg.params_dtype)}
+
+
+def _token_mask_from(mask: jax.Array | None) -> jax.Array | None:
+    """(B|1, 1, 1, S) key-padding attention mask -> (B|1, S) token mask for
+    MoE routing; any other mask shape (combined/causal) carries no usable
+    per-token padding info, so routing treats all tokens as real."""
+    if mask is not None and mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[-2] == 1:
+        return mask[:, 0, 0, :]
+    return None
+
+
+def _ffn_sublayer_apply(
+    params: Params,
+    h: jax.Array,
+    cfg: ModelConfig,
+    aux_box: list,
+    token_mask: jax.Array | None = None,
+):
+    """Dense or MoE FFN, depending on which key the layer params carry; a MoE
+    layer's load-balance loss lands in ``aux_box[0]``."""
+    if "moe" in params:
+        y, aux = moe_apply(
+            params["moe"], h,
+            num_experts=cfg.moe_experts,
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            activation=cfg.ffn_activation,
+            token_mask=token_mask,
+        )
+        aux_box[0] = aux
+        return y
+    return ffn_apply(params["ffn"], h, cfg.ffn_activation)
+
+
+def encoder_layer_init(
+    key: jax.Array, cfg: ModelConfig, layer_index: int = 0
+) -> Params:
     k_mha, k_ffn = jax.random.split(key)
     return {
         "mha": mha_init(k_mha, cfg.d_model, cfg.num_heads, cfg.params_dtype),
-        "ffn": ffn_init(k_ffn, cfg.d_model, cfg.dff, cfg.params_dtype),
+        **_ffn_sublayer_init(k_ffn, cfg, layer_uses_moe(cfg, layer_index)),
         "ln1": layernorm_init(cfg.d_model, cfg.params_dtype),
         "ln2": layernorm_init(cfg.d_model, cfg.params_dtype),
     }
@@ -60,9 +112,13 @@ def encoder_layer_apply(
     rng: jax.Array | None = None,
     deterministic: bool = True,
     return_weights: bool = False,
-) -> tuple[jax.Array, jax.Array | None]:
+) -> tuple[jax.Array, jax.Array | None, jax.Array | None]:
+    """Returns (x, attn_weights, moe_aux_loss) — the aux loss is None for
+    dense-FFN layers and a scalar for MoE layers; returning it (rather than
+    side-channeling) keeps it correct under ``jax.checkpoint``."""
     r1, r2 = (None, None) if rng is None else jax.random.split(rng)
     weights_box = [None]
+    aux_box: list = [None]
 
     def attn(h):
         out, w, _ = mha_apply(
@@ -78,17 +134,17 @@ def encoder_layer_apply(
     x = _sublayer(cfg, params["ln1"], x, attn, r1, deterministic)
     x = _sublayer(
         cfg, params["ln2"], x,
-        lambda h: ffn_apply(params["ffn"], h, cfg.ffn_activation),
+        lambda h: _ffn_sublayer_apply(params, h, cfg, aux_box, _token_mask_from(mask)),
         r2, deterministic,
     )
-    return x, weights_box[0]
+    return x, weights_box[0], aux_box[0]
 
 
 def encoder_init(key: jax.Array, cfg: ModelConfig) -> Params:
     keys = jax.random.split(key, cfg.num_layers + 1)
     params: Params = {
         "embedding": embedding_init(keys[0], cfg.input_vocab_size, cfg.d_model, cfg.params_dtype),
-        "layers": [encoder_layer_init(keys[i + 1], cfg) for i in range(cfg.num_layers)],
+        "layers": [encoder_layer_init(keys[i + 1], cfg, i) for i in range(cfg.num_layers)],
     }
     if cfg.norm_scheme == "pre":
         params["final_ln"] = layernorm_init(cfg.d_model, cfg.params_dtype)
@@ -131,7 +187,9 @@ def encoder_apply(
     return_weights: bool = False,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """(B, S) ids -> (B, S, d_model) encodings plus (optionally) per-layer
-    attention maps keyed like the reference's dict (``Decoder.py:75-76`` style)."""
+    attention maps keyed like the reference's dict (``Decoder.py:75-76`` style).
+    MoE configs additionally report the summed load-balance loss under the
+    reserved key ``"moe_aux_encoder"`` in the weights dict."""
     rngs = (
         [None] * (cfg.num_layers + 1)
         if rng is None
@@ -139,6 +197,7 @@ def encoder_apply(
     )
     x = embed_prologue(params["embedding"], ids, cfg, rngs[0], deterministic)
     attn_weights: dict[str, jax.Array] = {}
+    aux_total = None
 
     def layer_call(layer, x, mask, r):
         return encoder_layer_apply(
@@ -150,9 +209,13 @@ def encoder_apply(
         # backward pass instead of keeping them live (cfg.remat docstring).
         layer_call = jax.checkpoint(layer_call)
     for i, layer in enumerate(params["layers"]):
-        x, w = layer_call(layer, x, mask, rngs[i + 1])
+        x, w, aux = layer_call(layer, x, mask, rngs[i + 1])
         if w is not None:
             attn_weights[f"encoder_layer{i + 1}"] = w
+        if aux is not None:
+            aux_total = aux if aux_total is None else aux_total + aux
+    if aux_total is not None:
+        attn_weights["moe_aux_encoder"] = aux_total
     if cfg.norm_scheme == "pre":
         x = layernorm_apply(params["final_ln"], x, cfg.layernorm_epsilon)
     return x, attn_weights

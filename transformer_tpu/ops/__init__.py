@@ -11,6 +11,7 @@ from transformer_tpu.ops.attention import (
     mha_init,
 )
 from transformer_tpu.ops.ffn import ffn_apply, ffn_init
+from transformer_tpu.ops.moe import expert_capacity, moe_apply, moe_init
 from transformer_tpu.ops.masks import (
     attention_bias,
     make_causal_mask,
@@ -22,8 +23,11 @@ from transformer_tpu.ops.positional import sinusoidal_positional_encoding
 __all__ = [
     "attention_bias",
     "dot_product_attention",
+    "expert_capacity",
     "ffn_apply",
     "ffn_init",
+    "moe_apply",
+    "moe_init",
     "make_causal_mask",
     "make_padding_mask",
     "make_seq2seq_masks",

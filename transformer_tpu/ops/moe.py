@@ -1,0 +1,208 @@
+"""Mixture-of-Experts feed-forward layer with expert parallelism.
+
+No reference counterpart: the reference's FFN is a dense two-matmul block
+(``point_ffn.py:3-7``) — this is a capability extension (SURVEY.md §2.4 lists
+expert parallelism as out of reference scope), built TPU-first:
+
+- **Static shapes.** Routing uses the classic capacity-factor dispatch
+  (Shazeer-style top-k gating): every (batch-row, expert) pair gets a fixed
+  number of token slots ``C``, and dispatch/combine are dense one-hot
+  tensors contracted with einsums. No sort, no gather/scatter with
+  data-dependent shapes — everything XLA sees is a fixed-shape matmul, so
+  the MXU stays fed and nothing recompiles.
+- **Expert parallelism as sharding.** Expert weights are stacked on a leading
+  ``E`` axis — ``in/kernel (E, M, F)`` — and sharded over the ``expert`` mesh
+  axis (``parallel/sharding.py``). The all-to-all that moves token slots to
+  their experts is inserted by GSPMD from the sharding annotations, riding
+  ICI; there is no hand-written collective. EP composes with tp ('model'
+  shards F) and fsdp exactly like the dense FFN.
+- **Remat-safe aux loss.** The load-balance loss is a real function output
+  threaded through the layer stack (``models/encoder.py``), not a side
+  channel, so it survives ``jax.checkpoint``.
+
+Routing math (fp32 throughout; expert matmuls in the compute dtype):
+top-k gates renormalized over the selected experts, earlier choices get
+capacity priority, tokens overflowing an expert's capacity are dropped (the
+residual connection around the FFN sublayer carries them through unchanged).
+The auxiliary load-balancing loss is the standard Switch/GShard form
+``E * sum_e f_e * p_e`` (f_e: fraction of tokens whose first choice is e;
+p_e: mean router probability), which is 1.0 at perfect balance.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.ops.ffn import _ACTIVATIONS
+from transformer_tpu.ops.nn import Params, glorot_uniform
+
+# Active mesh for expert-sharding constraints (see ``expert_mesh`` below).
+_EXPERT_MESH: list = []
+
+
+@contextlib.contextmanager
+def expert_mesh(mesh):
+    """Activate sharding hints inside ``moe_apply``: the distributed engine
+    wraps its forward in this context (``parallel/distributed.py``) so the
+    dispatch/combine einsums are annotated with the exact resharding points —
+    tokens move from batch-sharded (data×fsdp×expert) to expert-sharded via
+    ONE GSPMD all-to-all instead of the partitioner's replicate-then-slice
+    fallback. Without the context (single chip, plain jit) the hints vanish."""
+    _EXPERT_MESH.append(mesh)
+    try:
+        yield
+    finally:
+        _EXPERT_MESH.pop()
+
+
+def _constrain(x: jax.Array, *spec) -> jax.Array:
+    if not _EXPERT_MESH:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _EXPERT_MESH[-1]
+
+    def present(a):
+        axes = a if isinstance(a, tuple) else (a,)
+        return all(ax in mesh.shape for ax in axes)
+
+    cleaned = P(*[(a if a is None or present(a) else None) for a in spec])
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, cleaned))
+
+
+def moe_init(
+    key: jax.Array,
+    d_model: int,
+    dff: int,
+    num_experts: int,
+    param_dtype=jnp.float32,
+) -> Params:
+    """Router plus ``num_experts`` independent FFNs stacked on a leading E
+    axis. Per-expert fan-in/fan-out matches ``ffn_init`` so a 1-expert MoE is
+    parameter-for-parameter the dense FFN."""
+    k_router, k_in, k_out = jax.random.split(key, 3)
+    E = num_experts
+
+    def stacked(k, d_in, d_out):
+        keys = jax.random.split(k, E)
+        return jnp.stack(
+            [glorot_uniform(keys[e], (d_in, d_out), param_dtype, d_in, d_out) for e in range(E)]
+        )
+
+    return {
+        "router": {"kernel": glorot_uniform(k_router, (d_model, E), param_dtype, d_model, E)},
+        "in": {
+            "kernel": stacked(k_in, d_model, dff),
+            "bias": jnp.zeros((E, dff), param_dtype),
+        },
+        "out": {
+            "kernel": stacked(k_out, dff, d_model),
+            "bias": jnp.zeros((E, d_model), param_dtype),
+        },
+    }
+
+
+def expert_capacity(
+    seq_len: int, num_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    """Token slots per (batch-row, expert): the even-split share
+    ``S * k / E`` scaled by the capacity factor, at least 1, at most S."""
+    even = seq_len * top_k / num_experts
+    return max(1, min(seq_len, math.ceil(even * capacity_factor)))
+
+
+def moe_apply(
+    params: Params,
+    x: jax.Array,
+    *,
+    num_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    activation: str = "relu",
+    token_mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(B, S, M) -> ((B, S, M), aux_loss).
+
+    Each batch row is a routing group: capacity is budgeted per row, so the
+    dispatch tensors stay (B, S, E, C) and the whole layer is four einsums.
+    Dropped tokens (capacity overflow) produce zero output here; the caller's
+    residual connection passes their activations through unchanged.
+
+    ``token_mask`` (B, S) bool, True = real token: PAD positions are neither
+    dispatched (they'd steal capacity slots from real tokens' choices) nor
+    counted in the load-balance statistics (a mostly-PAD batch would
+    otherwise train the router to balance padding).
+    """
+    B, S, M = x.shape
+    E, k = num_experts, min(top_k, num_experts)
+    C = expert_capacity(S, E, k, capacity_factor)
+    act = _ACTIVATIONS[activation]
+    dtype = x.dtype
+
+    # --- routing (fp32: softmax over experts + cumsum bookkeeping) ---------
+    router_logits = jnp.einsum(
+        "bsm,me->bse", x.astype(jnp.float32), params["router"]["kernel"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
+    live = (
+        None
+        if token_mask is None
+        else jnp.broadcast_to(token_mask.astype(jnp.float32), (B, S))
+    )
+
+    gates, indices = jax.lax.top_k(probs, k)  # (B, S, k)
+    # Renormalize over the selected experts (GShard top-2 convention).
+    gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+
+    combine = jnp.zeros((B, S, E, C), jnp.float32)
+    counts = jnp.zeros((B, E), jnp.float32)  # slots used so far, per expert
+    for j in range(k):
+        oh = jax.nn.one_hot(indices[..., j], E, dtype=jnp.float32)  # (B, S, E)
+        if live is not None:
+            oh = oh * live[..., None]  # PADs claim no slot
+        # Position of each token within its chosen expert's capacity buffer:
+        # tokens earlier in the sequence (and earlier choice ranks j) first.
+        pos = jnp.cumsum(oh, axis=1) - oh + counts[:, None, :]  # (B, S, E)
+        pos_j = jnp.sum(pos * oh, axis=-1)  # (B, S)
+        fits = (pos_j < C).astype(jnp.float32) * jnp.sum(oh, axis=-1)
+        counts = counts + jnp.sum(oh * fits[..., None], axis=1)
+        slot = jax.nn.one_hot(pos_j.astype(jnp.int32), C, dtype=jnp.float32)  # (B, S, C)
+        dispatch_j = oh[..., None] * slot[..., None, :] * fits[..., None, None]
+        combine = combine + gates[..., j, None, None] * dispatch_j
+
+    dispatch = (combine > 0).astype(dtype)  # (B, S, E, C)
+
+    # --- expert computation (MXU matmuls in the compute dtype) -------------
+    # The B dim of the slot tensors drops the 'expert' axis (tokens now live
+    # on it via the E dim): that boundary is the token->expert all-to-all.
+    xe = jnp.einsum("bsec,bsm->becm", dispatch, x)  # (B, E, C, M)
+    xe = _constrain(xe, ("data", "fsdp"), "expert", None, None)
+    h = act(
+        jnp.einsum("becm,emf->becf", xe, params["in"]["kernel"].astype(dtype))
+        + params["in"]["bias"].astype(dtype)[None, :, None, :]
+    )
+    h = _constrain(h, ("data", "fsdp"), "expert", None, "model")
+    ye = (
+        jnp.einsum("becf,efm->becm", h, params["out"]["kernel"].astype(dtype))
+        + params["out"]["bias"].astype(dtype)[None, :, None, :]
+    )
+    ye = _constrain(ye, ("data", "fsdp"), "expert", None, None)
+    y = jnp.einsum("bsec,becm->bsm", combine.astype(dtype), ye)
+    y = _constrain(y, ("data", "fsdp", "expert"), None, None)
+
+    # --- load-balance auxiliary loss (Switch: E * sum_e f_e * p_e) ---------
+    # Statistics over REAL tokens only when a token_mask is given.
+    first_choice = jax.nn.one_hot(indices[..., 0], E, dtype=jnp.float32)
+    if live is None:
+        f = jnp.mean(first_choice, axis=(0, 1))  # fraction routed to e
+        p = jnp.mean(probs, axis=(0, 1))  # mean router prob for e
+    else:
+        n = jnp.maximum(jnp.sum(live), 1.0)
+        f = jnp.sum(first_choice * live[..., None], axis=(0, 1)) / n
+        p = jnp.sum(probs * live[..., None], axis=(0, 1)) / n
+    aux = jnp.float32(E) * jnp.sum(f * p)
+    return y, aux

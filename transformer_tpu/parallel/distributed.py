@@ -95,8 +95,30 @@ def _seq_parallel_forward(
         src_p, _ = pad_ids(src)
         tar_p, extra = pad_ids(tar_inp)
         with sequence_parallel(ctx):
-            logits = inner(params, src_p, tar_p, rng, deterministic)
-        return logits[:, : logits.shape[1] - extra]
+            out = inner(params, src_p, tar_p, rng, deterministic)
+        logits, aux = out if isinstance(out, tuple) else (out, None)
+        logits = logits[:, : logits.shape[1] - extra]
+        return logits if aux is None else (logits, aux)
+
+    return forward
+
+
+def _expert_parallel_forward(
+    mesh: Mesh, model_cfg: ModelConfig, base_forward: Callable | None
+) -> Callable:
+    """Forward wrapper for MoE models on meshes with an ``expert`` axis:
+    activates the ``ops.moe.expert_mesh`` context so every ``moe_apply``
+    traced inside annotates its dispatch/combine boundaries — GSPMD then
+    moves token slots to their experts with one all-to-all over ICI instead
+    of its replicate-then-slice fallback."""
+    from transformer_tpu.ops.moe import expert_mesh
+    from transformer_tpu.train.trainer import _default_forward
+
+    inner = base_forward or _default_forward(model_cfg)
+
+    def forward(params, src, tar_inp, rng, deterministic):
+        with expert_mesh(mesh):
+            return inner(params, src, tar_inp, rng, deterministic)
 
     return forward
 
@@ -113,11 +135,29 @@ def make_sharded_steps(
 
     A mesh with ``pipe > 1`` swaps in the GPipe-pipelined forward; all other
     axes keep the plain SPMD-sharded step."""
+    if model_cfg.moe_experts and mesh.shape.get("pipe", 1) > 1:
+        # Guarded here (the public entry point; DistributedTrainer reaches it
+        # too): the GPipe forward neither stacks heterogeneous layer params
+        # (moe_every > 1) nor collects the load-balance loss, and the metrics
+        # shardings below would mismatch the aux-less pipelined step.
+        raise ValueError(
+            "pipe>1 with a MoE model is not yet wired through the GPipe path"
+        )
+    ep = mesh.shape.get("expert", 1)
+    if ep > 1 and model_cfg.moe_experts % ep:
+        # Without this check _divisible would silently replicate every expert
+        # weight — the user would get the memory profile of no EP at all.
+        raise ValueError(
+            f"moe_experts {model_cfg.moe_experts} must be divisible by the "
+            f"expert mesh axis ({ep}) for expert weights to shard"
+        )
     data_sh = NamedSharding(mesh, batch_spec(mesh, shard_seq))
     repl = NamedSharding(mesh, P())
     metrics_sh = {
         "loss": repl, "loss_sum": repl, "weight": repl, "correct": repl
     }
+    if model_cfg.moe_experts:
+        metrics_sh["moe_aux"] = repl
     forward_fn = (
         _pipelined_forward(mesh, model_cfg, train_cfg)
         if mesh.shape.get("pipe", 1) > 1
@@ -128,6 +168,8 @@ def make_sharded_steps(
         and model_cfg.attention_impl in ("ring", "ulysses")
     ):
         forward_fn = _seq_parallel_forward(mesh, model_cfg, forward_fn)
+    if model_cfg.moe_experts and mesh.shape.get("expert", 1) > 1:
+        forward_fn = _expert_parallel_forward(mesh, model_cfg, forward_fn)
     train_step = jax.jit(
         make_train_step(model_cfg, train_cfg, forward_fn=forward_fn),
         in_shardings=(shardings, data_sh, data_sh, repl),
@@ -184,14 +226,16 @@ class DistributedTrainer(Trainer):
         shard_seq: bool = False,
         **kwargs: Any,
     ) -> None:
-        if train_cfg.batch_size % (mesh.shape["data"] * mesh.shape["fsdp"]):
+        batch_axes = mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape.get("expert", 1)
+        if train_cfg.batch_size % batch_axes:
             raise ValueError(
                 f"global batch size {train_cfg.batch_size} must be divisible "
-                f"by data×fsdp = {mesh.shape['data'] * mesh.shape['fsdp']} "
+                f"by data×fsdp×expert = {batch_axes} "
                 "(reference check: distributed_train.py:154-158)"
             )
         n_stages = mesh.shape.get("pipe", 1)
         if n_stages > 1:
+            # (MoE+pipe is rejected by make_sharded_steps, reached below.)
             unsupported = {
                 a: mesh.shape[a]
                 for a in ("model", "seq")

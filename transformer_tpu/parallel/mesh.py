@@ -2,16 +2,18 @@
 
 Replaces the reference's replica topology (an explicit ``'/device:GPU:i'``
 list handed to MirroredStrategy, ``distributed_train.py:137-138``) with a
-logical 5-axis mesh:
+logical 6-axis mesh:
 
-    ('data', 'fsdp', 'model', 'seq', 'pipe')
+    ('data', 'fsdp', 'model', 'seq', 'pipe', 'expert')
 
-- gradients psum over 'data'+'fsdp' (ICI),
+- gradients psum over 'data'+'fsdp'+'expert' (ICI),
 - parameters/optimizer shard over 'fsdp',
 - attention heads / dff shard over 'model',
 - sequence blocks shard over 'seq' (ring attention),
 - layer-stack stages over 'pipe' (GPipe schedule; activations hop
-  stage-to-stage via ppermute — ``parallel/pipeline.py``).
+  stage-to-stage via ppermute — ``parallel/pipeline.py``),
+- MoE expert weights over 'expert' (token slots reach their experts via the
+  GSPMD-inserted all-to-all — ``ops/moe.py``).
 
 TPU pods are multi-process by construction — ``initialize_distributed`` wraps
 ``jax.distributed.initialize`` so the same entry point works single-host (no-op)

@@ -42,6 +42,14 @@ _RULES: list[tuple[str, P]] = [
     (r"embedding/table$", P("fsdp", None)),
     (r"(query|key|value)/kernel$", P("fsdp", "model", None)),
     (r"(query|key|value)/bias$", P("model", None)),
+    # MoE (ops/moe.py): experts stacked on a leading E axis shard over
+    # 'expert' (expert parallelism), composing with tp on dff and fsdp on
+    # d_model exactly like the dense FFN; the router stays replicated.
+    (r"moe/router/kernel$", P(None, None)),
+    (r"moe/in/kernel$", P("expert", "fsdp", "model")),
+    (r"moe/in/bias$", P("expert", "model")),
+    (r"moe/out/kernel$", P("expert", "model", "fsdp")),
+    (r"moe/out/bias$", P("expert", None)),
     (r"out/kernel$", P("model", None, "fsdp")),
     (r"out/bias$", P(None)),
     (r"ffn/in/kernel$", P("fsdp", "model")),
@@ -69,8 +77,9 @@ def _path_str(path) -> str:
 
 
 def _divisible(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
-    """Drop sharding on any dim that doesn't divide its mesh axis (or when the
-    spec has more dims than the array — scalars in odd spots)."""
+    """Drop sharding on any dim that doesn't divide its mesh axis, names the
+    mesh doesn't carry (hand-built meshes without e.g. an 'expert' axis), or
+    when the spec has more dims than the array — scalars in odd spots."""
     if len(spec) > len(shape):
         return P()
     out = []
@@ -79,6 +88,9 @@ def _divisible(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
             out.append(None)
             continue
         axes = axis if isinstance(axis, tuple) else (axis,)
+        if any(a not in mesh.shape for a in axes):
+            out.append(None)
+            continue
         size = 1
         for a in axes:
             size *= mesh.shape[a]
@@ -108,8 +120,10 @@ def state_shardings(state_shape: Any, mesh: Mesh) -> Any:
 
 
 def batch_spec(mesh: Mesh, shard_seq: bool = False) -> P:
-    """(B, S) token batches shard over batch on data×fsdp (fsdp is data
-    parallelism with parameter sharding on top) and optionally over sequence
-    on 'seq' (ring attention)."""
-    del mesh
-    return P(("data", "fsdp"), "seq" if shard_seq else None)
+    """(B, S) token batches shard over batch on data×fsdp×expert (fsdp is
+    data parallelism with parameter sharding on top; the expert axis splits
+    tokens too, so MoE dispatch becomes a GSPMD all-to-all instead of full
+    replication) and optionally over sequence on 'seq' (ring attention).
+    Axes a hand-built mesh doesn't carry are skipped."""
+    axes = tuple(a for a in ("data", "fsdp", "expert") if a in mesh.shape)
+    return P(axes, "seq" if shard_seq else None)

@@ -72,16 +72,31 @@ def make_train_step(
         step_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
-            logits = forward_fn(params, src, tar_inp, step_rng, False)
-            return masked_cross_entropy(
+            logits, aux = _split_forward_out(
+                forward_fn(params, src, tar_inp, step_rng, False)
+            )
+            loss, metrics = masked_cross_entropy(
                 logits, tar_out,
                 label_smoothing=train_cfg.label_smoothing,
                 normalization=train_cfg.loss_normalization,
                 batch_size=train_cfg.batch_size,
             )
+            metrics = {"loss": loss, **metrics}
+            total = loss
+            if aux is not None:
+                # MoE load-balance loss: differentiated (keeps the router
+                # honest) but reported separately — "loss" stays comparable
+                # CE across dense and MoE configs.
+                total = loss + model_cfg.moe_aux_weight * aux
+            if model_cfg.moe_experts:
+                # Key presence follows the CONFIG, not the forward's return
+                # shape, so metric pytrees (and distributed out_shardings)
+                # stay fixed even under a custom aux-less forward_fn.
+                metrics["moe_aux"] = jnp.float32(0.0) if aux is None else aux
+            return total, metrics
 
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        return _apply(state, grads, {"loss": loss, **metrics})
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        return _apply(state, grads, metrics)
 
     def accum_train_step(state: TrainState, src, tgt, rng):
         """Gradient accumulation: lax.scan over ``accum`` micro-steps, each a
@@ -106,13 +121,27 @@ def make_train_step(
         )
 
         def sum_loss_fn(params, s, ti, to, r):
-            logits = forward_fn(params, s, ti, r, False)
+            logits, aux = _split_forward_out(forward_fn(params, s, ti, r, False))
             _, m = masked_cross_entropy(
                 logits, to,
                 label_smoothing=train_cfg.label_smoothing,
                 normalization="tokens",  # only the sums are consumed
             )
-            return m["loss_sum"], m
+            obj = m["loss_sum"]
+            if model_cfg.moe_experts:  # key presence follows the config
+                # Scaled so that the /denom at the end yields a mean of
+                # per-chunk aux losses in BOTH normalizations: token-weighted
+                # under "tokens" (denom = total non-pad tokens), uniform under
+                # "batch" (denom = batch_size) — without the scale matching
+                # the denominator, the effective aux weight would grow with
+                # tokens-per-sample under the reference's "batch" rule.
+                if train_cfg.loss_normalization == "tokens":
+                    aux_scale = m["weight"]
+                else:
+                    aux_scale = jnp.float32(train_cfg.batch_size) / accum
+                m["moe_aux_sum"] = (0.0 if aux is None else aux) * aux_scale
+                obj = obj + model_cfg.moe_aux_weight * m["moe_aux_sum"]
+            return obj, m
 
         grad_fn = jax.grad(sum_loss_fn, has_aux=True)
 
@@ -130,6 +159,8 @@ def make_train_step(
             "weight": jnp.zeros((), jnp.float32),
             "correct": jnp.zeros((), jnp.float32),
         }
+        if model_cfg.moe_experts:
+            zero_m["moe_aux_sum"] = jnp.zeros((), jnp.float32)
         (grads, m), _ = jax.lax.scan(body, (zero_g, zero_m), chunks)
         if train_cfg.loss_normalization == "tokens":
             denom = jnp.maximum(m["weight"], 1.0)
@@ -137,12 +168,36 @@ def make_train_step(
             denom = jnp.float32(train_cfg.batch_size)
         grads = jax.tree.map(lambda g: g / denom, grads)
         loss = m["loss_sum"] / denom
-        return _apply(state, grads, {"loss": loss, **m})
+        aux_sum = m.pop("moe_aux_sum", None)
+        metrics = {"loss": loss, **m}
+        if aux_sum is not None:
+            metrics["moe_aux"] = aux_sum / denom  # mean per-chunk aux (see above)
+        return _apply(state, grads, metrics)
 
     return accum_train_step if accum > 1 else train_step
 
 
+def _split_forward_out(out) -> tuple[jax.Array, jax.Array | None]:
+    """Forward functions return logits, or (logits, moe_aux_loss) for MoE
+    configs — normalize to a pair."""
+    return out if isinstance(out, tuple) else (out, None)
+
+
 def _default_forward(model_cfg: ModelConfig) -> Callable:
+    if model_cfg.moe_experts:
+
+        def forward_moe(params, src, tar_inp, rng, deterministic):
+            logits, attn = transformer_apply(
+                params, src, tar_inp, model_cfg,
+                rng=None if deterministic else rng, deterministic=deterministic,
+            )
+            # The stacks report summed load-balance losses under reserved
+            # keys (models/encoder.py encoder_apply docstring).
+            aux = attn.get("moe_aux_encoder", 0.0) + attn.get("moe_aux_decoder", 0.0)
+            return logits, jnp.asarray(aux, jnp.float32)
+
+        return forward_moe
+
     def forward(params, src, tar_inp, rng, deterministic):
         logits, _ = transformer_apply(
             params, src, tar_inp, model_cfg,
@@ -164,14 +219,19 @@ def make_eval_step(
 
     def eval_step(state: TrainState, src, tgt):
         tar_inp, tar_out = _shift_targets(tgt)
-        logits = forward_fn(state.params, src, tar_inp, None, True)
+        logits, aux = _split_forward_out(
+            forward_fn(state.params, src, tar_inp, None, True)
+        )
         loss, metrics = masked_cross_entropy(
             logits, tar_out,
             label_smoothing=train_cfg.label_smoothing,
             normalization=train_cfg.loss_normalization,
             batch_size=train_cfg.batch_size,
         )
-        return {"loss": loss, **metrics}
+        metrics = {"loss": loss, **metrics}
+        if model_cfg.moe_experts:  # key presence follows the config
+            metrics["moe_aux"] = jnp.float32(0.0) if aux is None else aux
+        return metrics
 
     return eval_step
 
@@ -196,10 +256,15 @@ class MetricAccumulator:
 
     def update(self, metrics: dict[str, Any]) -> None:
         part = {k: metrics[k] for k in self._KEYS}
+        if "moe_aux" in metrics:
+            # Token-weighted so the epoch aggregate is the same weighted mean
+            # the per-step metric reports (steps with more real tokens count
+            # proportionally).
+            part["moe_aux_w"] = metrics["moe_aux"] * metrics["weight"]
         if self._sums is None:
             self._sums = part
         else:
-            self._sums = {k: self._sums[k] + part[k] for k in self._KEYS}
+            self._sums = {k: self._sums.get(k, 0.0) + part[k] for k in part}
 
     def _get(self, key: str) -> float:
         return 0.0 if self._sums is None else float(self._sums[key])
@@ -223,6 +288,13 @@ class MetricAccumulator:
     @property
     def accuracy(self) -> float:
         return self.correct / max(self.weight, 1.0)
+
+    @property
+    def moe_aux(self) -> float | None:
+        """Token-weighted mean MoE load-balance loss, or None for dense runs."""
+        if self._sums is None or "moe_aux_w" not in self._sums:
+            return None
+        return float(self._sums["moe_aux_w"]) / max(self.weight, 1.0)
 
 
 class Trainer:
@@ -346,11 +418,13 @@ class Trainer:
                     if cfg.log_every_steps and step % cfg.log_every_steps == 0:
                         loss = self.train_metrics.loss  # device_get: blocks
                         self.step_timer.sync()
+                        aux = self.train_metrics.moe_aux
                         self.log_fn(
                             f"epoch {epoch + 1} step {step} "
                             f"loss {loss:.4f} "
                             f"acc {self.train_metrics.accuracy:.4f} "
-                            f"({self.step_timer.steps_per_sec:.2f} steps/s)"
+                            + (f"moe_aux {aux:.3f} " if aux is not None else "")
+                            + f"({self.step_timer.steps_per_sec:.2f} steps/s)"
                         )
                     if (
                         test_ds is not None
@@ -423,6 +497,8 @@ class Trainer:
         w = self.writers["train"]
         w.scalar("loss", self.train_metrics.loss, epoch)
         w.scalar("accuracy", self.train_metrics.accuracy, epoch)
+        if self.train_metrics.moe_aux is not None:
+            w.scalar("moe_aux", self.train_metrics.moe_aux, epoch)
         lr = make_lr_schedule(self.model_cfg, self.train_cfg)(
             int(jax.device_get(self.state.step))
         )
