@@ -88,7 +88,8 @@ def _configs():
 
 
 def bench_config(
-    name: str, n_steps: int = 20, mode: str = "full", profile_dir: str = ""
+    name: str, n_steps: int = 20, mode: str = "full", profile_dir: str = "",
+    loss_chunks: int = 1,
 ) -> dict:
     """One measurement. ``mode`` attributes step time without trace tooling:
 
@@ -97,6 +98,9 @@ def bench_config(
     - smallvocab: train step with a 2k-row OUTPUT vocab (input embedding
                   untouched) — isolates the vocab-projection/CE share
                   (32k-vocab logits matmul is the prime MFU suspect at seq 64)
+
+    ``loss_chunks > 1`` additionally runs the chunked vocab-projection/CE
+    path (TrainConfig.loss_chunks) for A/B against the monolithic loss.
     """
     import dataclasses
 
@@ -110,6 +114,8 @@ def bench_config(
     )
 
     model_cfg, train_cfg, batch, seq = _configs()[name]
+    if loss_chunks > 1:
+        train_cfg = dataclasses.replace(train_cfg, loss_chunks=loss_chunks)
     if mode == "smallvocab":
         model_cfg = dataclasses.replace(model_cfg, target_vocab_size=2048)
     dev = jax.devices()[0]
@@ -160,9 +166,11 @@ def bench_config(
     tokens_per_step = batch * (seq - 1)
     value = tokens_per_step * n_steps / dt
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    tag = (f" [{mode}]" if mode != "full" else "") + (
+        f" [chunks={loss_chunks}]" if loss_chunks > 1 else ""
+    )
     return {
-        "metric": f"{name} train throughput"
-        + (f" [{mode}]" if mode != "full" else ""),
+        "metric": f"{name} train throughput" + tag,
         "value": round(value, 1),
         "unit": "tokens/sec/chip",
         "config": {
@@ -196,6 +204,11 @@ def main() -> None:
         "--profile_dir", default="",
         help="capture a jax.profiler trace of the timing loop into this dir",
     )
+    ap.add_argument(
+        "--loss_chunks", type=int, default=1,
+        help="A/B the chunked vocab-projection/CE path (TrainConfig."
+        "loss_chunks); 1 = monolithic loss",
+    )
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
@@ -210,7 +223,8 @@ def main() -> None:
                 subprocess.run(
                     [sys.executable, __file__, "--steps", str(args.steps),
                      "--configs", name, "--modes", mode,
-                     "--profile_dir", args.profile_dir],
+                     "--profile_dir", args.profile_dir,
+                     "--loss_chunks", str(args.loss_chunks)],
                     check=False,
                 )
         return
@@ -220,7 +234,10 @@ def main() -> None:
     try:
         print(
             json.dumps(
-                bench_config(name, args.steps, mode, args.profile_dir)
+                bench_config(
+                    name, args.steps, mode, args.profile_dir,
+                    loss_chunks=args.loss_chunks,
+                )
             ),
             flush=True,
         )

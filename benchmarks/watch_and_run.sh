@@ -64,12 +64,13 @@ while :; do
   fi
   touch .tpu_busy
   if [ -n "$R" ]; then
-    log "running throughput rows: $R"
-    timeout 2400 python benchmarks/run.py --configs "$R" >>"$ROWS" 2>>bench_r2.err
-    log "rows pass done (rc=$?)"
+    # One config per pass so the relay is re-probed between measurements.
+    log "running throughput row: ${R%%,*}"
+    timeout 2400 python benchmarks/run.py --configs "${R%%,*}" >>"$ROWS" 2>>bench_r2.err
+    log "row pass done (rc=$?)"
   elif [ -n "$A" ]; then
-    log "running base attribution: $A"
-    timeout 2400 python benchmarks/run.py --configs base --modes "$A" >>"$ATTR" 2>>bench_r2.err
+    log "running base attribution: ${A%%,*}"
+    timeout 2400 python benchmarks/run.py --configs base --modes "${A%%,*}" >>"$ATTR" 2>>bench_r2.err
     log "attribution pass done (rc=$?)"
   else
     log "running BLEU convergence (resumes from checkpoint if interrupted)"

@@ -91,6 +91,63 @@ class TestLoss:
         assert float(smooth) > float(sharp)
 
 
+class TestChunkedLoss:
+    """loss_chunks: vocab projection + CE over sequence slices
+    (train/loss.py chunked_cross_entropy_from_hidden) — must match the
+    monolithic path exactly in loss, metrics, and gradients."""
+
+    def _batch(self, seed=0):
+        r = np.random.default_rng(seed)
+        src = jnp.asarray(r.integers(1, 28, (4, 9)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 28, (4, 9)), jnp.int32)
+        return src, tgt
+
+    @pytest.mark.parametrize("chunks", [2, 3])  # 3 does not divide S-1=8
+    def test_train_step_matches_monolithic(self, chunks):
+        import dataclasses
+
+        src, tgt = self._batch()
+        rng = jax.random.PRNGKey(1)
+        tc_mono = TCFG
+        tc_chunk = dataclasses.replace(TCFG, loss_chunks=chunks)
+        s1 = create_train_state(jax.random.PRNGKey(0), TINY, tc_mono)
+        s2 = create_train_state(jax.random.PRNGKey(0), TINY, tc_chunk)
+        s1, m1 = jax.jit(make_train_step(TINY, tc_mono))(s1, src, tgt, rng)
+        s2, m2 = jax.jit(make_train_step(TINY, tc_chunk))(s2, src, tgt, rng)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+        for k in ("loss_sum", "weight", "correct"):
+            np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_eval_step_matches_monolithic(self):
+        import dataclasses
+
+        src, tgt = self._batch(1)
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        m1 = jax.jit(make_eval_step(TINY, TCFG))(state, src, tgt)
+        tc = dataclasses.replace(TCFG, loss_chunks=4)
+        m2 = jax.jit(make_eval_step(TINY, tc))(state, src, tgt)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+    def test_tied_output_supported(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY, tie_embeddings=True, tie_output=True)
+        tc = dataclasses.replace(TCFG, loss_chunks=2)
+        src, tgt = self._batch(2)
+        state = create_train_state(jax.random.PRNGKey(0), cfg, tc)
+        state, m = jax.jit(make_train_step(cfg, tc))(state, src, tgt, jax.random.PRNGKey(1))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_rejects_grad_accum_combination(self):
+        import dataclasses
+
+        tc = dataclasses.replace(TCFG, loss_chunks=2, grad_accum_steps=2)
+        with pytest.raises(ValueError, match="loss_chunks"):
+            make_train_step(TINY, tc)
+
+
 class TestCheckpoint:
     def test_roundtrip(self, tmp_path):
         state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)

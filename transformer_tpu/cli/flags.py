@@ -109,6 +109,11 @@ def define_flags() -> None:
     flags.DEFINE_integer(
         "grad_accum", 1,
         "gradient-accumulation micro-steps per optimizer update (1 = off)")
+    flags.DEFINE_integer(
+        "loss_chunks", 1,
+        "compute the vocab projection + CE over this many sequence slices so "
+        "the full (B,S,V) logits tensor is never materialized (1 = off) — "
+        "the memory lever for big-vocab/long-context configs")
     flags.DEFINE_boolean(
         "eval_bleu", True,
         "compute corpus BLEU on the test split after training")
@@ -160,6 +165,7 @@ def flags_to_train_config() -> TrainConfig:
         pp_microbatches=FLAGS.pp_microbatches,
         eval_max_batches=FLAGS.eval_max_batches,
         grad_accum_steps=FLAGS.grad_accum,
+        loss_chunks=FLAGS.loss_chunks,
     )
 
 

@@ -153,6 +153,11 @@ class TrainConfig:
     # micro-steps and sum gradients before one optimizer update — train
     # big-model global batches on small-HBM chips. 1 = off.
     grad_accum_steps: int = 1
+    # Chunked loss: compute the final vocab projection + CE over this many
+    # sequence slices (train/loss.py chunked_cross_entropy_from_hidden) so
+    # the full (B, S, V) logits tensor is never materialized — the memory
+    # lever for big-vocab/long-context configs. 1 = off.
+    loss_chunks: int = 1
 
     def __post_init__(self) -> None:
         if self.loss_normalization not in ("tokens", "batch"):

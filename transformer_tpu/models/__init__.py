@@ -15,7 +15,9 @@ from transformer_tpu.models.encoder import (
     encoder_layer_init,
 )
 from transformer_tpu.models.transformer import (
+    project_logits,
     transformer_apply,
+    transformer_hidden_apply,
     transformer_init,
 )
 
@@ -28,6 +30,8 @@ __all__ = [
     "encoder_init",
     "encoder_layer_apply",
     "encoder_layer_init",
+    "project_logits",
     "transformer_apply",
+    "transformer_hidden_apply",
     "transformer_init",
 ]

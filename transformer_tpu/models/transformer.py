@@ -53,7 +53,15 @@ def _logits(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return dense_apply(params["final"], x)
 
 
-def transformer_apply(
+def project_logits(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Final vocab projection: (..., d_model) hiddens -> (..., V) raw logits
+    (tied or untied per ``cfg.tie_output``). Public counterpart of the
+    projection inside ``transformer_apply`` for callers that project slices
+    (chunked loss, decode)."""
+    return _logits(params, x, cfg)
+
+
+def transformer_hidden_apply(
     params: Params,
     inp: jax.Array | None,
     tar: jax.Array,
@@ -63,12 +71,13 @@ def transformer_apply(
     return_weights: bool = False,
     pad_id: int = PAD_ID,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """Forward pass: (inp, tar) token ids -> (logits, attention_weights).
+    """Forward pass up to (but not including) the final vocab projection:
+    returns ((B, S_tgt, d_model) decoder hiddens, attention_weights).
 
-    ``inp`` is ignored (may be None) when ``cfg.decoder_only``; ``tar`` is then
-    the causal-LM token sequence. Logits are raw (no softmax), shaped
-    (B, S_tgt, target_vocab_size) — same contract as reference
-    ``Transformer.py:30-32``.
+    Split out of ``transformer_apply`` so the chunked-loss path
+    (``train/loss.py chunked_cross_entropy_from_hidden``) can project and
+    score the (huge) vocab logits a sequence slice at a time instead of
+    materializing the full (B, S, V) tensor.
     """
     if cfg.decoder_only:
         self_mask = make_padding_mask(tar, pad_id)  # ANDed with causal inside MHA
@@ -76,7 +85,7 @@ def transformer_apply(
             params["decoder"], tar, None, self_mask, None, cfg,
             rng, deterministic, return_weights,
         )
-        return _logits(params, x, cfg), attn
+        return x, attn
 
     # Encoder self-attention and decoder cross-attention both mask source
     # padding; decoder self-attention masks target padding, with causality
@@ -96,7 +105,30 @@ def transformer_apply(
         params["decoder"], tar, enc_out, self_mask, cross_mask, cfg,
         r_dec, deterministic, return_weights,
     )
-    return _logits(params, x, cfg), {**enc_attn, **dec_attn}
+    return x, {**enc_attn, **dec_attn}
+
+
+def transformer_apply(
+    params: Params,
+    inp: jax.Array | None,
+    tar: jax.Array,
+    cfg: ModelConfig,
+    rng: jax.Array | None = None,
+    deterministic: bool = True,
+    return_weights: bool = False,
+    pad_id: int = PAD_ID,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Forward pass: (inp, tar) token ids -> (logits, attention_weights).
+
+    ``inp`` is ignored (may be None) when ``cfg.decoder_only``; ``tar`` is then
+    the causal-LM token sequence. Logits are raw (no softmax), shaped
+    (B, S_tgt, target_vocab_size) — same contract as reference
+    ``Transformer.py:30-32``.
+    """
+    x, attn = transformer_hidden_apply(
+        params, inp, tar, cfg, rng, deterministic, return_weights, pad_id
+    )
+    return _logits(params, x, cfg), attn
 
 
 def transformer_decode_step(

@@ -64,3 +64,68 @@ def masked_cross_entropy(
         (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32) * mask
     )
     return loss, {"loss_sum": loss_sum, "weight": weight, "correct": correct}
+
+
+def chunked_cross_entropy_from_hidden(
+    params,
+    hidden: jax.Array,
+    targets: jax.Array,
+    cfg,
+    *,
+    num_chunks: int,
+    label_smoothing: float = 0.0,
+    normalization: str = "tokens",
+    batch_size: int | None = None,
+    pad_id: int = PAD_ID,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Masked CE computed WITHOUT materializing the full (B, S, V) logits.
+
+    The (B, S, d_model) decoder hiddens (``transformer_hidden_apply``) are
+    scanned in ``num_chunks`` sequence slices; each slice runs the vocab
+    projection + CE under ``jax.checkpoint``, so only (B, S/num_chunks, V)
+    logits are ever live and the backward pass recomputes them per slice.
+    The memory lever for big-vocab models: at B=4, S=4096, V=32k the full
+    logits tensor is ~1 GB bf16 (+2 GB fp32 log-softmax) per step; chunked,
+    peak drops by the chunk factor for one extra projection matmul in the
+    backward. Numerics are identical to ``masked_cross_entropy`` up to
+    summation order (exact-sum metrics, both normalization rules).
+    """
+    from transformer_tpu.models.transformer import project_logits
+
+    B, S, _ = hidden.shape
+    chunk = -(-S // num_chunks)
+    padded = chunk * num_chunks
+    if padded != S:
+        # Pad with PAD-target positions: zero loss weight, dead compute only
+        # on the final slice.
+        hidden = jnp.pad(hidden, ((0, 0), (0, padded - S), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, padded - S)), constant_values=pad_id)
+    h = hidden.reshape(B, num_chunks, chunk, hidden.shape[-1]).transpose(1, 0, 2, 3)
+    t = targets.reshape(B, num_chunks, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_sums(hc, tc):
+        logits = project_logits(params, hc, cfg)
+        _, m = masked_cross_entropy(
+            logits, tc,
+            label_smoothing=label_smoothing,
+            normalization="tokens",  # only the exact sums are consumed
+            pad_id=pad_id,
+        )
+        return m["loss_sum"], m["weight"], m["correct"]
+
+    def body(acc, xs):
+        ls, w, c = chunk_sums(*xs)
+        return (acc[0] + ls, acc[1] + w, acc[2] + c), None
+
+    zero = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+    (loss_sum, weight, correct), _ = jax.lax.scan(body, zero, (h, t))
+    if normalization == "tokens":
+        loss = loss_sum / jnp.maximum(weight, 1.0)
+    elif normalization == "batch":
+        if batch_size is None:
+            raise ValueError("normalization='batch' requires batch_size")
+        loss = loss_sum / float(batch_size)
+    else:
+        raise ValueError(f"unknown normalization {normalization!r}")
+    return loss, {"loss_sum": loss_sum, "weight": weight, "correct": correct}

@@ -25,7 +25,10 @@ import optax
 from transformer_tpu.config import ModelConfig, TrainConfig
 from transformer_tpu.models import transformer_apply
 from transformer_tpu.train.checkpoint import CheckpointManager
-from transformer_tpu.train.loss import masked_cross_entropy
+from transformer_tpu.train.loss import (
+    chunked_cross_entropy_from_hidden,
+    masked_cross_entropy,
+)
 from transformer_tpu.train.state import TrainState, make_optimizer
 from transformer_tpu.utils.preemption import PreemptionGuard
 from transformer_tpu.utils.profiling import Profiler, StepTimer
@@ -55,6 +58,20 @@ def make_train_step(
     mesh has a ``pipe`` axis); default is the plain ``transformer_apply``.
     """
     tx = tx or make_optimizer(model_cfg, train_cfg)
+    chunked = train_cfg.loss_chunks > 1
+    if chunked:
+        if forward_fn is not None:
+            raise ValueError(
+                "loss_chunks>1 needs the hidden-state forward and so does not "
+                "compose with a custom forward_fn (pipeline / sequence-"
+                "parallel wrappers)"
+            )
+        if train_cfg.grad_accum_steps > 1:
+            raise ValueError(
+                "loss_chunks>1 and grad_accum_steps>1 are both sequential "
+                "memory levers; use one (they are not wired together)"
+            )
+        hidden_forward = _default_hidden_forward(model_cfg)
     if forward_fn is None:
         forward_fn = _default_forward(model_cfg)
     accum = max(1, train_cfg.grad_accum_steps)
@@ -72,15 +89,19 @@ def make_train_step(
         step_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
-            logits, aux = _split_forward_out(
-                forward_fn(params, src, tar_inp, step_rng, False)
-            )
-            loss, metrics = masked_cross_entropy(
-                logits, tar_out,
-                label_smoothing=train_cfg.label_smoothing,
-                normalization=train_cfg.loss_normalization,
-                batch_size=train_cfg.batch_size,
-            )
+            if chunked:
+                x, aux = hidden_forward(params, src, tar_inp, step_rng, False)
+                loss, metrics = _chunked_loss(params, x, tar_out, model_cfg, train_cfg)
+            else:
+                logits, aux = _split_forward_out(
+                    forward_fn(params, src, tar_inp, step_rng, False)
+                )
+                loss, metrics = masked_cross_entropy(
+                    logits, tar_out,
+                    label_smoothing=train_cfg.label_smoothing,
+                    normalization=train_cfg.loss_normalization,
+                    batch_size=train_cfg.batch_size,
+                )
             metrics = {"loss": loss, **metrics}
             total = loss
             if aux is not None:
@@ -183,6 +204,42 @@ def _split_forward_out(out) -> tuple[jax.Array, jax.Array | None]:
     return out if isinstance(out, tuple) else (out, None)
 
 
+def _collect_moe_aux(attn: dict) -> jax.Array:
+    """Sum the stacks' reserved load-balance keys (models/encoder.py
+    encoder_apply docstring) into one fp32 scalar."""
+    return jnp.asarray(
+        attn.get("moe_aux_encoder", 0.0) + attn.get("moe_aux_decoder", 0.0),
+        jnp.float32,
+    )
+
+
+def _chunked_loss(params, hidden, tar_out, model_cfg, train_cfg):
+    """The train/eval-shared call into the chunked vocab-projection/CE path."""
+    return chunked_cross_entropy_from_hidden(
+        params, hidden, tar_out, model_cfg,
+        num_chunks=train_cfg.loss_chunks,
+        label_smoothing=train_cfg.label_smoothing,
+        normalization=train_cfg.loss_normalization,
+        batch_size=train_cfg.batch_size,
+    )
+
+
+def _default_hidden_forward(model_cfg: ModelConfig) -> Callable:
+    """Like ``_default_forward`` but stops before the vocab projection:
+    returns ((B, S, d_model) hiddens, moe_aux|None) for the chunked-loss
+    path (``train_cfg.loss_chunks``)."""
+    from transformer_tpu.models import transformer_hidden_apply
+
+    def forward(params, src, tar_inp, rng, deterministic):
+        x, attn = transformer_hidden_apply(
+            params, src, tar_inp, model_cfg,
+            rng=None if deterministic else rng, deterministic=deterministic,
+        )
+        return x, _collect_moe_aux(attn) if model_cfg.moe_experts else None
+
+    return forward
+
+
 def _default_forward(model_cfg: ModelConfig) -> Callable:
     if model_cfg.moe_experts:
 
@@ -191,10 +248,7 @@ def _default_forward(model_cfg: ModelConfig) -> Callable:
                 params, src, tar_inp, model_cfg,
                 rng=None if deterministic else rng, deterministic=deterministic,
             )
-            # The stacks report summed load-balance losses under reserved
-            # keys (models/encoder.py encoder_apply docstring).
-            aux = attn.get("moe_aux_encoder", 0.0) + attn.get("moe_aux_decoder", 0.0)
-            return logits, jnp.asarray(aux, jnp.float32)
+            return logits, _collect_moe_aux(attn)
 
         return forward_moe
 
@@ -214,11 +268,21 @@ def make_eval_step(
     forward_fn: Callable | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
     """Forward-only eval step (reference ``test_step``, ``train.py:144-157``)."""
+    chunked = train_cfg.loss_chunks > 1 and forward_fn is None
+    if chunked:
+        hidden_forward = _default_hidden_forward(model_cfg)
     if forward_fn is None:
         forward_fn = _default_forward(model_cfg)
 
     def eval_step(state: TrainState, src, tgt):
         tar_inp, tar_out = _shift_targets(tgt)
+        if chunked:
+            x, aux = hidden_forward(state.params, src, tar_inp, None, True)
+            loss, metrics = _chunked_loss(state.params, x, tar_out, model_cfg, train_cfg)
+            metrics = {"loss": loss, **metrics}
+            if model_cfg.moe_experts:
+                metrics["moe_aux"] = jnp.float32(0.0) if aux is None else aux
+            return metrics
         logits, aux = _split_forward_out(
             forward_fn(state.params, src, tar_inp, None, True)
         )
