@@ -242,7 +242,11 @@ def main() -> None:
             flush=True,
         )
     except Exception as e:  # record the failure as a JSON line
-        tag = f" [{mode}]" if mode != "full" else ""
+        # Same tag as the success path, so failures attribute to the right
+        # mode/variant in the rows file.
+        tag = (f" [{mode}]" if mode != "full" else "") + (
+            f" [chunks={args.loss_chunks}]" if args.loss_chunks > 1 else ""
+        )
         print(
             json.dumps(
                 {"metric": f"{name} train throughput{tag}", "error": str(e)}

@@ -44,6 +44,24 @@ missing_attr() {
 
 bleu_missing() { ! grep -q '"bleu"' "$BLEU" 2>/dev/null; }
 
+pick_least_failed() {
+  # args: jsonl-file, metric-suffix-template items... — choose the item with
+  # the fewest recorded "error" lines, so one persistently failing config
+  # cannot starve the rest (ties: first). Template "%s" is the item.
+  local file=$1 tmpl=$2; shift 2
+  local best="" best_n=-1 c n metric
+  for c in "$@"; do
+    # shellcheck disable=SC2059
+    metric=$(printf "$tmpl" "$c")
+    # -F: the metric text contains [] which grep would treat as a char class.
+    n=$(grep -cF "\"metric\": \"$metric\", \"error\"" "$file" 2>/dev/null || true)
+    if [ "$best_n" -lt 0 ] || [ "$n" -lt "$best_n" ]; then
+      best="$c"; best_n="$n"
+    fi
+  done
+  echo "$best"
+}
+
 log "watchdog started (pid $$)"
 while :; do
   R=$(missing_rows)
@@ -64,13 +82,18 @@ while :; do
   fi
   touch .tpu_busy
   if [ -n "$R" ]; then
-    # One config per pass so the relay is re-probed between measurements.
-    log "running throughput row: ${R%%,*}"
-    timeout 2400 python benchmarks/run.py --configs "${R%%,*}" >>"$ROWS" 2>>bench_r2.err
+    # One config per pass (relay re-probed between measurements), choosing
+    # the least-failed missing config so a bad one can't starve the rest.
+    IFS=, read -ra RARR <<<"$R"
+    PICK=$(pick_least_failed "$ROWS" "%s train throughput" "${RARR[@]}")
+    log "running throughput row: $PICK"
+    timeout 2400 python benchmarks/run.py --configs "$PICK" >>"$ROWS" 2>>bench_r2.err
     log "row pass done (rc=$?)"
   elif [ -n "$A" ]; then
-    log "running base attribution: ${A%%,*}"
-    timeout 2400 python benchmarks/run.py --configs base --modes "${A%%,*}" >>"$ATTR" 2>>bench_r2.err
+    IFS=, read -ra AARR <<<"$A"
+    PICK=$(pick_least_failed "$ATTR" "base train throughput [%s]" "${AARR[@]}")
+    log "running base attribution: $PICK"
+    timeout 2400 python benchmarks/run.py --configs base --modes "$PICK" >>"$ATTR" 2>>bench_r2.err
     log "attribution pass done (rc=$?)"
   else
     log "running BLEU convergence (resumes from checkpoint if interrupted)"

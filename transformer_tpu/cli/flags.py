@@ -47,6 +47,10 @@ def define_flags() -> None:
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
+    flags.DEFINE_enum(
+        "position_scheme", "sinusoidal", ["sinusoidal", "rope"],
+        "position encoding: additive sinusoidal table (reference behavior) "
+        "or rotary q/k embeddings (long-context; relative positions)")
     flags.DEFINE_boolean(
         "decoder_only", False,
         "causal-LM mode (cli.train and cli.distributed_train): train a "
@@ -133,6 +137,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         dropout_rate=FLAGS.dropout_rate,
         max_position=max(FLAGS.sequence_length, 64),
         norm_scheme=FLAGS.norm_scheme,
+        position_scheme=FLAGS.position_scheme,
         decoder_only=FLAGS.decoder_only,
         tie_embeddings=FLAGS.tie_embeddings,
         tie_output=FLAGS.tie_output,

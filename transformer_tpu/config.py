@@ -43,6 +43,11 @@ class ModelConfig:
     # Post-LN matches the reference residual wiring (``Encoder.py:19-29``);
     # "pre" is offered because pre-LN is markedly more stable at depth.
     norm_scheme: str = "post"  # "post" | "pre"
+    # Position encoding: "sinusoidal" = the reference's additive table
+    # (``positionalencoding.py:8-23``); "rope" = rotary embeddings applied to
+    # q/k in self-attention (``ops/positional.py apply_rope``) — the
+    # long-context extension (relative positions, no additive table).
+    position_scheme: str = "sinusoidal"  # "sinusoidal" | "rope"
     layernorm_epsilon: float = 1e-6
     # BASELINE.json configs[3]: tied src/tgt embeddings and tied output projection.
     tie_embeddings: bool = False  # share encoder/decoder embedding tables
@@ -85,6 +90,16 @@ class ModelConfig:
             )
         if self.norm_scheme not in ("post", "pre"):
             raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
+        if self.position_scheme not in ("sinusoidal", "rope"):
+            raise ValueError(
+                f"position_scheme must be 'sinusoidal' or 'rope', got "
+                f"{self.position_scheme!r}"
+            )
+        if self.position_scheme == "rope" and (self.d_model // self.num_heads) % 2:
+            raise ValueError(
+                "position_scheme='rope' needs an even head_dim "
+                f"(got {self.d_model // self.num_heads})"
+            )
         if self.ffn_activation not in ("relu", "gelu", "silu"):
             raise ValueError(f"unknown ffn_activation {self.ffn_activation!r}")
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):

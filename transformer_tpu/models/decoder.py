@@ -88,6 +88,7 @@ def decoder_layer_apply(
             cache=cache,
             flash_block_q=cfg.flash_block_q,
             flash_block_k=cfg.flash_block_k,
+            rope=cfg.position_scheme == "rope",
         )
         boxes[0], boxes[2] = w, new_cache
         return out

@@ -127,6 +127,7 @@ def encoder_layer_apply(
             return_weights=return_weights,
             flash_block_q=cfg.flash_block_q,
             flash_block_k=cfg.flash_block_k,
+            rope=cfg.position_scheme == "rope",
         )
         weights_box[0] = w
         return out
@@ -171,9 +172,14 @@ def embed_prologue(
         )
     x = embedding_lookup(embedding, ids, cfg.compute_dtype)
     x = x * jnp.asarray(cfg.d_model**0.5, dtype=cfg.compute_dtype)
-    table = sinusoidal_positional_encoding(cfg.max_position, cfg.d_model, cfg.compute_dtype)
-    pos = jax.lax.dynamic_slice_in_dim(table, position_offset, seq_len, axis=0)
-    x = x + pos[None, :, :]
+    if cfg.position_scheme == "sinusoidal":
+        table = sinusoidal_positional_encoding(
+            cfg.max_position, cfg.d_model, cfg.compute_dtype
+        )
+        pos = jax.lax.dynamic_slice_in_dim(table, position_offset, seq_len, axis=0)
+        x = x + pos[None, :, :]
+    # "rope": nothing additive here — positions enter via q/k rotation inside
+    # self-attention (ops/attention.py mha_apply).
     return dropout(rng, x, cfg.dropout_rate, deterministic)
 
 

@@ -18,9 +18,10 @@ from transformer_tpu.ops.masks import (
     make_padding_mask,
     make_seq2seq_masks,
 )
-from transformer_tpu.ops.positional import sinusoidal_positional_encoding
+from transformer_tpu.ops.positional import apply_rope, sinusoidal_positional_encoding
 
 __all__ = [
+    "apply_rope",
     "attention_bias",
     "dot_product_attention",
     "expert_capacity",

@@ -133,6 +133,7 @@ def mha_apply(
     precomputed_kv: tuple[jax.Array, jax.Array] | None = None,
     flash_block_q: int = 128,
     flash_block_k: int = 128,
+    rope: bool = False,
 ) -> tuple[jax.Array, jax.Array | None, dict[str, Any] | None]:
     """Multi-head attention forward.
 
@@ -152,6 +153,11 @@ def mha_apply(
       precomputed_kv: optional (k, v) already projected to (B, S_k, H, D) —
         used by cross-attention during decode so the static encoder output is
         projected once, not once per generated token.
+      rope: rotate q and the NEWLY-projected k by their absolute positions
+        (``ops.positional.apply_rope``) — self-attention only (cross-attention
+        callers must leave this False; cached keys are stored rotated, so the
+        decode path composes for free). Positions come from ``cache["index"]``
+        when decoding, else ``arange(S_q)``.
 
     Returns ``(out, weights|None, cache|None)``.
     """
@@ -162,6 +168,15 @@ def mha_apply(
     else:
         k = _project(params["key"], x_kv, dtype)
         v = _project(params["value"], x_kv, dtype)
+
+    if rope:
+        from transformer_tpu.ops.positional import apply_rope
+
+        offset = cache["index"] if cache is not None else 0
+        positions = offset + jnp.arange(x_q.shape[1])
+        q = apply_rope(q, positions)
+        if precomputed_kv is None:
+            k = apply_rope(k, positions)
 
     if cache is not None:
         idx = cache["index"]

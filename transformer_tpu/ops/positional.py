@@ -33,3 +33,34 @@ def sinusoidal_positional_encoding(
     odds = angles[:, 1::2]
     table = jnp.concatenate([jnp.sin(evens), jnp.cos(odds)], axis=-1)
     return table.astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    base: float = 10000.0,
+) -> jax.Array:
+    """Rotary position embedding (no reference counterpart — the reference is
+    additive-sinusoidal only; RoPE is the long-context extension for the
+    decoder-only 4096-token config, ``ModelConfig.position_scheme="rope"``).
+
+    Rotates each (even, odd-half) channel pair of ``x`` (B, S, H, D) by an
+    angle proportional to its absolute position, which makes q·k depend only
+    on the RELATIVE distance between query and key. Half-split layout
+    (first D/2 channels pair with the last D/2) — contiguous slices, no
+    interleaved gather, TPU-lane friendly. ``positions`` is (S,) absolute
+    token positions (pass ``offset + arange(S)`` during KV-cache decode).
+    Angles in fp32; output in x.dtype.
+    """
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    inv_freq = jnp.power(
+        jnp.float32(base), -jnp.arange(0, half, dtype=jnp.float32) / half
+    )  # (D/2,)
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (S, D/2)
+    cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, D/2)
+    sin = jnp.sin(angles)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -26,6 +26,22 @@ import jax.numpy as jnp
 from transformer_tpu.config import PAD_ID
 
 
+def _normalize(
+    loss_sum: jax.Array,
+    weight: jax.Array,
+    normalization: str,
+    batch_size: int | None,
+) -> jax.Array:
+    """The shared tokens/batch normalization rule (monolithic and chunked CE)."""
+    if normalization == "tokens":
+        return loss_sum / jnp.maximum(weight, 1.0)
+    if normalization == "batch":
+        if batch_size is None:
+            raise ValueError("normalization='batch' requires batch_size")
+        return loss_sum / float(batch_size)
+    raise ValueError(f"unknown normalization {normalization!r}")
+
+
 def masked_cross_entropy(
     logits: jax.Array,
     targets: jax.Array,
@@ -52,14 +68,7 @@ def masked_cross_entropy(
     mask = (targets != pad_id).astype(jnp.float32)
     loss_sum = jnp.sum(per_token * mask)
     weight = jnp.sum(mask)
-    if normalization == "tokens":
-        loss = loss_sum / jnp.maximum(weight, 1.0)
-    elif normalization == "batch":
-        if batch_size is None:
-            raise ValueError("normalization='batch' requires batch_size")
-        loss = loss_sum / float(batch_size)
-    else:
-        raise ValueError(f"unknown normalization {normalization!r}")
+    loss = _normalize(loss_sum, weight, normalization, batch_size)
     correct = jnp.sum(
         (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32) * mask
     )
@@ -120,12 +129,5 @@ def chunked_cross_entropy_from_hidden(
 
     zero = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
     (loss_sum, weight, correct), _ = jax.lax.scan(body, zero, (h, t))
-    if normalization == "tokens":
-        loss = loss_sum / jnp.maximum(weight, 1.0)
-    elif normalization == "batch":
-        if batch_size is None:
-            raise ValueError("normalization='batch' requires batch_size")
-        loss = loss_sum / float(batch_size)
-    else:
-        raise ValueError(f"unknown normalization {normalization!r}")
+    loss = _normalize(loss_sum, weight, normalization, batch_size)
     return loss, {"loss_sum": loss_sum, "weight": weight, "correct": correct}

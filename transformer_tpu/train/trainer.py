@@ -268,7 +268,16 @@ def make_eval_step(
     forward_fn: Callable | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
     """Forward-only eval step (reference ``test_step``, ``train.py:144-157``)."""
-    chunked = train_cfg.loss_chunks > 1 and forward_fn is None
+    chunked = train_cfg.loss_chunks > 1
+    if chunked and forward_fn is not None:
+        # Same contract as make_train_step: silently materializing the full
+        # (B, S, V) logits would OOM in exactly the config loss_chunks exists
+        # to protect.
+        raise ValueError(
+            "loss_chunks>1 needs the hidden-state forward and so does not "
+            "compose with a custom forward_fn (pipeline / sequence-parallel "
+            "wrappers)"
+        )
     if chunked:
         hidden_forward = _default_hidden_forward(model_cfg)
     if forward_fn is None:
