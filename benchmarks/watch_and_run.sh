@@ -55,6 +55,7 @@ pick_least_failed() {
     metric=$(printf "$tmpl" "$c")
     # -F: the metric text contains [] which grep would treat as a char class.
     n=$(grep -cF "\"metric\": \"$metric\", \"error\"" "$file" 2>/dev/null || true)
+    n=${n:-0}  # missing file: grep prints nothing, not 0
     if [ "$best_n" -lt 0 ] || [ "$n" -lt "$best_n" ]; then
       best="$c"; best_n="$n"
     fi

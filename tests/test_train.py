@@ -91,6 +91,79 @@ class TestLoss:
         assert float(smooth) > float(sharp)
 
 
+class TestAsyncCheckpoint:
+    """AsyncCheckpointManager: background disk writes, synchronous device
+    snapshot (so donated-buffer invalidation can't corrupt a pending save)."""
+
+    def test_roundtrip_matches_sync(self, tmp_path):
+        from transformer_tpu.train import AsyncCheckpointManager
+
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        a = AsyncCheckpointManager(str(tmp_path / "async"), max_to_keep=3)
+        s = CheckpointManager(str(tmp_path / "sync"), max_to_keep=3)
+        a.save(state, step=5)
+        s.save(state, step=5)
+        a.wait()
+        other = create_train_state(jax.random.PRNGKey(1), TINY, TCFG)
+        ra = a.restore_latest(other)
+        rs = s.restore_latest(other)
+        for x, y in zip(jax.tree.leaves(ra), jax.tree.leaves(rs)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_snapshot_survives_donation(self, tmp_path):
+        """The state buffers are donated to the next train step immediately
+        after save() returns — the checkpoint must hold the OLD values."""
+        from transformer_tpu.train import AsyncCheckpointManager
+
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        step = jax.jit(make_train_step(TINY, TCFG), donate_argnums=(0,))
+        r = np.random.default_rng(0)
+        src = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        mgr = AsyncCheckpointManager(str(tmp_path), max_to_keep=3)
+        before = jax.tree.map(lambda a: np.asarray(a).copy(), state.params)
+        mgr.save(state, step=0)
+        # Donate the old buffers right away; the pending write must not see it.
+        state, _ = step(state, src, tgt, jax.random.PRNGKey(1))
+        mgr.wait()
+        restored = mgr.restore(
+            create_train_state(jax.random.PRNGKey(2), TINY, TCFG), 0
+        )
+        for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(restored.params)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_sequential_saves_rotate(self, tmp_path):
+        from transformer_tpu.train import AsyncCheckpointManager
+
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        mgr = AsyncCheckpointManager(str(tmp_path), max_to_keep=2)
+        for i in range(4):
+            mgr.save(state, step=i)
+        mgr.wait()
+        assert mgr.all_steps() == [2, 3]
+
+    def test_worker_failure_surfaces_on_wait(self, tmp_path):
+        """A failed background WRITE (ENOSPC, permissions, ...) must re-raise
+        from wait(), not vanish with the worker thread."""
+        from transformer_tpu.train import AsyncCheckpointManager
+
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        mgr = AsyncCheckpointManager(str(tmp_path / "x"), max_to_keep=2)
+
+        def boom(flat, step):
+            raise OSError("disk full")
+
+        mgr._write_replicated = boom
+        mgr.save(state, step=0)
+        with pytest.raises(OSError, match="disk full"):
+            mgr.wait()
+        # The failure is consumed: the manager is usable again afterwards.
+        del mgr.__dict__["_write_replicated"]
+        mgr.save(state, step=1)
+        mgr.wait()
+        assert mgr.all_steps() == [1]
+
+
 class TestChunkedLoss:
     """loss_chunks: vocab projection + CE over sequence slices
     (train/loss.py chunked_cross_entropy_from_hidden) — must match the

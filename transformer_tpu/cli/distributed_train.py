@@ -38,7 +38,7 @@ def main(argv) -> None:
     from transformer_tpu.data import load_dataset
     from transformer_tpu.parallel import DistributedTrainer, make_mesh
     from transformer_tpu.parallel.mesh import initialize_distributed
-    from transformer_tpu.train import CheckpointManager
+    from transformer_tpu.train import AsyncCheckpointManager, CheckpointManager
     from transformer_tpu.train.checkpoint import export_params
     from transformer_tpu.train.decode import translate
 
@@ -92,7 +92,8 @@ def main(argv) -> None:
     model_cfg = flags_to_model_config(
         src_tok.model_vocab_size, tgt_tok.model_vocab_size
     )
-    ckpt = CheckpointManager(train_cfg.ckpt_path, train_cfg.max_ckpt_keep)
+    ckpt_cls = AsyncCheckpointManager if FLAGS.async_checkpoint else CheckpointManager
+    ckpt = ckpt_cls(train_cfg.ckpt_path, train_cfg.max_ckpt_keep)
     import datetime
 
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")

@@ -119,6 +119,10 @@ def define_flags() -> None:
         "the full (B,S,V) logits tensor is never materialized (1 = off) — "
         "the memory lever for big-vocab/long-context configs")
     flags.DEFINE_boolean(
+        "async_checkpoint", False,
+        "write checkpoints from a background thread (device snapshot stays "
+        "synchronous); multi-process sharded states fall back to sync saves")
+    flags.DEFINE_boolean(
         "eval_bleu", True,
         "compute corpus BLEU on the test split after training")
     flags.DEFINE_integer(

@@ -34,7 +34,12 @@ def main(argv) -> None:
     import jax
 
     from transformer_tpu.data import load_dataset
-    from transformer_tpu.train import CheckpointManager, Trainer, create_train_state
+    from transformer_tpu.train import (
+        AsyncCheckpointManager,
+        CheckpointManager,
+        Trainer,
+        create_train_state,
+    )
     from transformer_tpu.train.checkpoint import export_params
     from transformer_tpu.train.decode import translate
 
@@ -84,7 +89,8 @@ def main(argv) -> None:
     state = create_train_state(
         jax.random.PRNGKey(train_cfg.seed), model_cfg, train_cfg
     )
-    ckpt = CheckpointManager(train_cfg.ckpt_path, train_cfg.max_ckpt_keep)
+    ckpt_cls = AsyncCheckpointManager if FLAGS.async_checkpoint else CheckpointManager
+    ckpt = ckpt_cls(train_cfg.ckpt_path, train_cfg.max_ckpt_keep)
     import datetime
 
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")

@@ -6,6 +6,7 @@ from transformer_tpu.train.loss import masked_cross_entropy
 from transformer_tpu.train.state import TrainState, create_train_state, make_optimizer
 from transformer_tpu.train.trainer import Trainer, make_eval_step, make_train_step
 from transformer_tpu.train.checkpoint import (
+    AsyncCheckpointManager,
     CheckpointManager,
     export_params,
     load_exported_params,
@@ -20,6 +21,7 @@ from transformer_tpu.train.decode import (
 from transformer_tpu.train.evaluate import bleu_on_pairs
 
 __all__ = [
+    "AsyncCheckpointManager",
     "CheckpointManager",
     "TrainState",
     "Trainer",

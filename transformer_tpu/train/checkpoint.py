@@ -121,12 +121,19 @@ class CheckpointManager:
             return self._save_sharded(state, step)
         if not self.is_primary:
             return None
+        self._write_replicated(_flatten(state), step)
+        return os.path.join(self.directory, f"ckpt_{step:08d}")
+
+    def _write_replicated(self, flat: dict[str, np.ndarray], step: int) -> None:
+        """Commit one replicated-format checkpoint from host arrays: tmp dir,
+        arrays.npz + meta.json, atomic rename, rotation. The single writer
+        both the sync path (inline) and ``AsyncCheckpointManager`` (worker
+        thread) go through, so the on-disk layout cannot diverge."""
         final = os.path.join(self.directory, f"ckpt_{step:08d}")
         tmp = final + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        flat = _flatten(state)
         np.savez(os.path.join(tmp, "arrays.npz"), **flat)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"step": step, "keys": sorted(flat)}, f)
@@ -134,7 +141,6 @@ class CheckpointManager:
             shutil.rmtree(final)
         os.replace(tmp, final)
         self._rotate()
-        return final
 
     def _save_sharded(self, state: Any, step: int) -> str:
         """Every process writes its addressable shards; no full-array gather.
@@ -365,6 +371,75 @@ class CheckpointManager:
         if step is None:
             return None
         return self.restore(target, step)
+
+    def wait(self) -> None:
+        """No pending writes in the synchronous manager — see
+        ``AsyncCheckpointManager.wait``."""
+
+
+class AsyncCheckpointManager(CheckpointManager):
+    """Checkpointing with the disk write off the training thread.
+
+    ``save`` snapshots device arrays to host RAM *synchronously* — this part
+    cannot be deferred: the trainer's donated-state step invalidates the old
+    buffers on the next call — then hands the host copy to a single worker
+    thread for the npz write, atomic rename, and rotation. The train loop
+    resumes after the snapshot (device-to-host DMA) instead of stalling on
+    disk I/O, which dominates for multi-GB states.
+
+    Falls back to the synchronous path when the state is device-sharded
+    across processes: the sharded protocol runs collective barriers
+    (``_save_sharded``), and collectives from a background thread would race
+    the training step's own collectives for device-order and deadlock.
+    Single-process sharded states (one host, several chips) carry the same
+    hazard — ``sync_global_devices`` is skipped there, but the shard reads
+    are device ops — so they too save synchronously.
+
+    ``wait()`` drains the queue; the trainer calls it before reporting a
+    preemption save durable and at the end of ``fit``. A worker failure
+    surfaces on the next ``save``/``wait`` call.
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer"
+        )
+        self._pending: Any | None = None
+
+    def save(self, state: Any, step: int | None = None) -> str | None:
+        step = int(state.step) if step is None else int(step)
+        leaves = jax.tree_util.tree_leaves(state)
+        if any(_is_distributed(l) for l in leaves):
+            return super().save(state, step)  # sync: see class docstring
+        self.wait()  # one write in flight at a time; surface prior failures
+        if not self.is_primary:
+            return None
+        # Overlap the device->host copies across leaves, then materialize.
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                leaf.copy_to_host_async()
+        flat = _flatten(state)
+        final = os.path.join(self.directory, f"ckpt_{step:08d}")
+        self._pending = self._executor.submit(self._write_replicated, flat, step)
+        return final
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) has committed; re-raises
+        a worker failure here rather than losing it."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def restore(self, target: Any, step: int) -> Any:
+        self.wait()  # never read a checkpoint mid-write
+        return super().restore(target, step)
+
+    def restore_latest(self, target: Any) -> Any | None:
+        self.wait()
+        return super().restore_latest(target)
 
 
 def export_params(params: Any, model_cfg, path: str) -> None:

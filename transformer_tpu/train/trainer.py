@@ -542,6 +542,10 @@ class Trainer:
                     or (epoch + 1) == cfg.epochs
                 ):
                     self.checkpoint.save(self.state)
+        if self.checkpoint is not None:
+            # Async managers write in the background; don't return (or let the
+            # process exit) with the final checkpoint still uncommitted.
+            self.checkpoint.wait()
         if self.profiler is not None:
             self.profiler.stop(block_on=self.state)
 
@@ -552,6 +556,8 @@ class Trainer:
         prefix = f"preemption (signal {guard.signal_received}) at step {step}: "
         if self.checkpoint is not None:
             path = self.checkpoint.save(self.state)
+            # The save must be durable before we report it (and exit).
+            self.checkpoint.wait()
             if path is not None:
                 self.log_fn(prefix + f"checkpoint saved to {path}")
             else:
