@@ -89,7 +89,7 @@ def _configs():
 
 def bench_config(
     name: str, n_steps: int = 20, mode: str = "full", profile_dir: str = "",
-    loss_chunks: int = 1,
+    loss_chunks: int = 1, batch_override: int = 0, seq_override: int = 0,
 ) -> dict:
     """One measurement. ``mode`` attributes step time without trace tooling:
 
@@ -114,6 +114,17 @@ def bench_config(
     )
 
     model_cfg, train_cfg, batch, seq = _configs()[name]
+    if batch_override or seq_override:
+        # MFU-ceiling probes: the BASELINE shapes are fixed for comparability,
+        # but utilization scales with tokens/step — overrides find the knee.
+        batch = batch_override or batch
+        seq = seq_override or seq
+        model_cfg = dataclasses.replace(
+            model_cfg, max_position=max(model_cfg.max_position, seq)
+        )
+        train_cfg = dataclasses.replace(
+            train_cfg, batch_size=batch, sequence_length=seq
+        )
     if loss_chunks > 1:
         train_cfg = dataclasses.replace(train_cfg, loss_chunks=loss_chunks)
     if mode == "smallvocab":
@@ -166,8 +177,10 @@ def bench_config(
     tokens_per_step = batch * (seq - 1)
     value = tokens_per_step * n_steps / dt
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
-    tag = (f" [{mode}]" if mode != "full" else "") + (
-        f" [chunks={loss_chunks}]" if loss_chunks > 1 else ""
+    tag = (
+        (f" [{mode}]" if mode != "full" else "")
+        + (f" [chunks={loss_chunks}]" if loss_chunks > 1 else "")
+        + (f" [b{batch}xs{seq}]" if batch_override or seq_override else "")
     )
     return {
         "metric": f"{name} train throughput" + tag,
@@ -209,6 +222,14 @@ def main() -> None:
         help="A/B the chunked vocab-projection/CE path (TrainConfig."
         "loss_chunks); 1 = monolithic loss",
     )
+    ap.add_argument(
+        "--batch", type=int, default=0,
+        help="override the config's batch size (MFU-ceiling probes; 0 = keep)",
+    )
+    ap.add_argument(
+        "--seq", type=int, default=0,
+        help="override the config's sequence length (0 = keep)",
+    )
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
@@ -224,7 +245,8 @@ def main() -> None:
                     [sys.executable, __file__, "--steps", str(args.steps),
                      "--configs", name, "--modes", mode,
                      "--profile_dir", args.profile_dir,
-                     "--loss_chunks", str(args.loss_chunks)],
+                     "--loss_chunks", str(args.loss_chunks),
+                     "--batch", str(args.batch), "--seq", str(args.seq)],
                     check=False,
                 )
         return
@@ -237,6 +259,7 @@ def main() -> None:
                 bench_config(
                     name, args.steps, mode, args.profile_dir,
                     loss_chunks=args.loss_chunks,
+                    batch_override=args.batch, seq_override=args.seq,
                 )
             ),
             flush=True,

@@ -91,6 +91,100 @@ class TestLoss:
         assert float(smooth) > float(sharp)
 
 
+class TestAdafactor:
+    def test_overfit_one_batch(self):
+        import dataclasses
+
+        tc = dataclasses.replace(TCFG, optimizer="adafactor", warmup_steps=20)
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        step = jax.jit(make_train_step(TINY, tc))
+        r = np.random.default_rng(0)
+        src = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        first = None
+        for _ in range(60):
+            state, m = step(state, src, tgt, rng)
+            first = float(m["loss"]) if first is None else first
+        assert float(m["loss"]) < first * 0.6
+
+    def test_state_is_factored(self):
+        """The point of Adafactor: optimizer state far smaller than Adam's
+        2x-params (factored second moments). Matrices must be >=128 on both
+        dims to factor (optax default min_dim_size_to_factor), so this uses a
+        model at that scale."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            TINY, d_model=128, dff=256, num_heads=4,
+            input_vocab_size=512, target_vocab_size=512,
+        )
+        tc_a = TCFG
+        tc_f = dataclasses.replace(TCFG, optimizer="adafactor")
+
+        def elems(state_field):
+            return sum(
+                int(np.prod(np.shape(x))) for x in jax.tree.leaves(state_field)
+            )
+
+        s_a = create_train_state(jax.random.PRNGKey(0), cfg, tc_a)
+        s_f = create_train_state(jax.random.PRNGKey(0), cfg, tc_f)
+        n_params = elems(s_a.params)
+        assert elems(s_a.opt_state) >= 2 * n_params
+        assert elems(s_f.opt_state) < n_params / 2
+
+    def test_rejects_unknown_optimizer(self):
+        with pytest.raises(ValueError, match="optimizer"):
+            TrainConfig(optimizer="sgd")
+
+
+class TestTopPSampling:
+    def test_nucleus_truncates_tail(self):
+        """With a peaked distribution and small top_p, sampling must only
+        ever return the top token; with top_p=1.0 the tail stays reachable."""
+        from transformer_tpu.train.decode import lm_generate
+        from transformer_tpu.models import transformer_init
+
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=30, target_vocab_size=30, max_position=32,
+            dtype="float32", dropout_rate=0.0, decoder_only=True,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.asarray([[28, 5, 9]], jnp.int32)  # BOS-led
+        greedy = lm_generate(params, prompt, cfg, 8, eos_id=29)
+        nucleus = lm_generate(
+            params, prompt, cfg, 8, eos_id=29,
+            rng=jax.random.PRNGKey(3), sample=True,
+            temperature=1e-3, top_p=0.5,
+        )
+        # Tiny temperature concentrates all mass on the argmax; the nucleus
+        # then contains exactly the top token, so sampling == greedy.
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+    def test_top_p_one_is_unfiltered_sampling(self):
+        from transformer_tpu.train.decode import lm_generate
+        from transformer_tpu.models import transformer_init
+
+        cfg = ModelConfig(
+            num_layers=1, d_model=16, num_heads=2, dff=32,
+            input_vocab_size=30, target_vocab_size=30, max_position=32,
+            dtype="float32", dropout_rate=0.0, decoder_only=True,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.asarray([[28, 5, 9]], jnp.int32)
+        a = lm_generate(
+            params, prompt, cfg, 8, eos_id=29,
+            rng=jax.random.PRNGKey(7), sample=True, temperature=1.0,
+        )
+        b = lm_generate(
+            params, prompt, cfg, 8, eos_id=29,
+            rng=jax.random.PRNGKey(7), sample=True, temperature=1.0,
+            top_p=1.0,
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestAsyncCheckpoint:
     """AsyncCheckpointManager: background disk writes, synchronous device
     snapshot (so donated-buffer invalidation can't corrupt a pending save)."""

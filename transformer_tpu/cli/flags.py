@@ -44,6 +44,10 @@ def define_flags() -> None:
     flags.DEFINE_enum("loss_normalization", "tokens", ["tokens", "batch"],
                       "CE normalization ('batch' = reference rule)")
     flags.DEFINE_float("max_grad_norm", 0.0, "global-norm gradient clip (0 = off)")
+    flags.DEFINE_enum(
+        "optimizer", "adam", ["adam", "adafactor"],
+        "adam = reference optimizer; adafactor = factored second moments "
+        "(far less optimizer-state memory for big models)")
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
@@ -166,6 +170,7 @@ def flags_to_train_config() -> TrainConfig:
         label_smoothing=FLAGS.label_smoothing,
         loss_normalization=FLAGS.loss_normalization,
         max_grad_norm=FLAGS.max_grad_norm,
+        optimizer=FLAGS.optimizer,
         buffer_size=FLAGS.buffer_size,
         max_ckpt_keep=FLAGS.max_ckpt_keep,
         ckpt_path=FLAGS.ckpt_path,

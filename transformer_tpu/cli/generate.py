@@ -2,7 +2,7 @@
 
     python -m transformer_tpu.cli.generate --export_path=model \
         --vocab_file=tgt_vocab.subwords [--prompts="der Mann"] \
-        [--temperature=0.8 --top_k=40]      # or read stdin, one per line
+        [--temperature=0.8 --top_k=40 --top_p=0.95]  # or stdin, one per line
 
 Counterpart of cli.translate for the causal-LM model family (BASELINE
 configs[4]); greedy by default, temperature/top-k sampling optional.
@@ -24,6 +24,7 @@ def define_generate_flags() -> None:
     flags.DEFINE_integer("max_new", 64, "max generated tokens per prompt")
     flags.DEFINE_float("temperature", 0.0, "sampling temperature (0 = greedy)")
     flags.DEFINE_integer("top_k", 0, "top-k truncation for sampling (0 = off)")
+    flags.DEFINE_float("top_p", 1.0, "nucleus (top-p) truncation for sampling (1 = off)")
     flags.DEFINE_integer("seed", 0, "sampling seed")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
 
@@ -56,7 +57,7 @@ def main(argv) -> None:
     outputs = generate(
         params, model_cfg, tok, prompts,
         max_new=FLAGS.max_new, temperature=FLAGS.temperature,
-        top_k=FLAGS.top_k, seed=FLAGS.seed,
+        top_k=FLAGS.top_k, top_p=FLAGS.top_p, seed=FLAGS.seed,
     )
     for out in outputs:
         print(out)

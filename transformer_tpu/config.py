@@ -142,6 +142,11 @@ class TrainConfig:
     adam_beta1: float = 0.9
     adam_beta2: float = 0.98
     adam_epsilon: float = 1e-9
+    # "adam": the reference's optimizer exactly (``train.py:65-66``).
+    # "adafactor": factored second moments — O(d_in + d_out) optimizer state
+    # per matrix instead of Adam's 2x params, the standard memory lever for
+    # big-model training.
+    optimizer: str = "adam"  # "adam" | "adafactor"
     label_smoothing: float = 0.0  # BASELINE.json configs[2] uses > 0
     # "tokens": mean CE over non-pad tokens (the sane default).
     # "batch": sum of per-token CE divided by global batch size — the
@@ -178,6 +183,10 @@ class TrainConfig:
         if self.loss_normalization not in ("tokens", "batch"):
             raise ValueError(
                 f"loss_normalization must be 'tokens' or 'batch', got {self.loss_normalization!r}"
+            )
+        if self.optimizer not in ("adam", "adafactor"):
+            raise ValueError(
+                f"optimizer must be 'adam' or 'adafactor', got {self.optimizer!r}"
             )
 
 

@@ -69,7 +69,7 @@ def greedy_decode(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new", "eos_id", "sample", "top_k"),
+    static_argnames=("cfg", "max_new", "eos_id", "sample", "top_k", "top_p"),
 )
 def lm_generate(
     params,
@@ -81,6 +81,7 @@ def lm_generate(
     sample: bool = False,
     temperature: float | jax.Array = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Causal-LM continuation: (B, P) BOS-led prompt (PAD-right allowed) ->
     (B, max_new) generated ids. The inference path for ``cfg.decoder_only``
@@ -91,9 +92,11 @@ def lm_generate(
     positions with per-layer KV caches; during the prompt it feeds the next
     prompt token (prefill), afterwards the previous sample. ``sample=False``
     is greedy argmax; ``sample=True`` draws from softmax(logits/temperature),
-    optionally truncated to the ``top_k`` highest-probability tokens.
-    ``temperature`` is a traced scalar — varying it does NOT recompile; only
-    the mode flag and ``top_k`` (a shape) are static.
+    optionally truncated to the ``top_k`` highest-probability tokens and/or
+    the nucleus of tokens whose cumulative probability reaches ``top_p``
+    (both filters applied: top-k first, then top-p over the survivors).
+    ``temperature`` is a traced scalar — varying it does NOT recompile; the
+    mode flag, ``top_k`` (a shape), and ``top_p`` (gates a sort) are static.
     """
     batch, prompt_len = prompt_ids.shape
     total = prompt_len + max_new
@@ -111,6 +114,18 @@ def lm_generate(
         if top_k > 0:
             kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            # Nucleus: keep the smallest prefix of the probability-sorted
+            # vocab whose mass reaches top_p (the top token always survives:
+            # its exclusive-cumulative mass is 0 < top_p).
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            exclusive = jnp.cumsum(probs, axis=-1) - probs
+            kept = exclusive < top_p
+            thresh = jnp.min(
+                jnp.where(kept, sorted_logits, jnp.inf), axis=-1, keepdims=True
+            )
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     def step(carry, t):
@@ -281,13 +296,15 @@ def generate(
     max_new: int = 64,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     seed: int = 0,
 ) -> list[str]:
     """Text-in/text-out continuation for ``cfg.decoder_only`` models: each
     prompt is BOS-led (matching the LM training windows, ``data.pipeline.
     make_lm_dataset``), generation stops per-row at EOS, output is
     detokenized continuation text. Prompt widths bucket like ``translate``.
-    ``temperature`` 0 = greedy; > 0 samples (with optional top-k)."""
+    ``temperature`` 0 = greedy; > 0 samples (with optional top-k and/or
+    top-p nucleus truncation)."""
     if not cfg.decoder_only:
         raise ValueError("generate() is for decoder_only models; use translate()")
     if isinstance(prompts, str):
@@ -309,6 +326,7 @@ def generate(
             params, jnp.asarray(ids), cfg, max_new, tokenizer.eos_id,
             rng=jax.random.PRNGKey(seed),
             sample=temperature > 0.0, temperature=temperature, top_k=top_k,
+            top_p=top_p,
         )
     )
     return _detokenize_rows(out, n, tokenizer)

@@ -37,15 +37,22 @@ def make_lr_schedule(model_cfg: ModelConfig, train_cfg: TrainConfig):
 
 def make_optimizer(model_cfg: ModelConfig, train_cfg: TrainConfig) -> optax.GradientTransformation:
     """Adam(β1=0.9, β2=0.98, ε=1e-9) under the noam schedule — the reference's
-    optimizer exactly (``train.py:65-66``), plus optional global-norm clipping
-    (absent from the reference; off by default)."""
+    optimizer exactly (``train.py:65-66``) — or Adafactor
+    (``train_cfg.optimizer="adafactor"``: factored second moments, the
+    big-model optimizer-memory lever; its state leaves replicate under the
+    path-rule shardings, which is fine — they are vectors, not matrices).
+    Plus optional global-norm clipping (absent from the reference; off by
+    default)."""
     schedule = make_lr_schedule(model_cfg, train_cfg)
-    tx = optax.adam(
-        learning_rate=schedule,
-        b1=train_cfg.adam_beta1,
-        b2=train_cfg.adam_beta2,
-        eps=train_cfg.adam_epsilon,
-    )
+    if train_cfg.optimizer == "adafactor":
+        tx = optax.adafactor(learning_rate=schedule)
+    else:
+        tx = optax.adam(
+            learning_rate=schedule,
+            b1=train_cfg.adam_beta1,
+            b2=train_cfg.adam_beta2,
+            eps=train_cfg.adam_epsilon,
+        )
     if train_cfg.max_grad_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(train_cfg.max_grad_norm), tx)
     return tx
