@@ -327,6 +327,28 @@ class TestShardedCheckpoint:
                 np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
             )
 
+    def test_async_sharded_matches_sync(self, tmp_path):
+        """Single-process sharded saves also go async (device snapshot sync,
+        disk write in the worker) and must produce a byte-equivalent layout."""
+        import os
+
+        from transformer_tpu.train import AsyncCheckpointManager, CheckpointManager
+
+        state, _ = self._sharded_state(MeshConfig(data=1, fsdp=8))
+        a = AsyncCheckpointManager(str(tmp_path / "async"), is_primary=True)
+        s = CheckpointManager(str(tmp_path / "sync"), is_primary=True)
+        pa = a.save(state, step=2)
+        ps = s.save(state, step=2)
+        a.wait()
+        assert sorted(os.listdir(pa)) == sorted(os.listdir(ps))
+        fresh, _ = self._sharded_state(MeshConfig(data=1, fsdp=8), seed=9)
+        ra = a.restore(fresh, step=2)
+        rs = s.restore(fresh, step=2)
+        for x, y in zip(jax.tree.leaves(ra), jax.tree.leaves(rs)):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))
+            )
+
     def test_unsharded_state_keeps_legacy_format(self, tmp_path):
         from transformer_tpu.train import CheckpointManager, create_train_state
         import os

@@ -129,18 +129,45 @@ class CheckpointManager:
         arrays.npz + meta.json, atomic rename, rotation. The single writer
         both the sync path (inline) and ``AsyncCheckpointManager`` (worker
         thread) go through, so the on-disk layout cannot diverge."""
-        final = os.path.join(self.directory, f"ckpt_{step:08d}")
-        tmp = final + ".tmp"
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
+        tmp = self._fresh_tmp(step)
         np.savez(os.path.join(tmp, "arrays.npz"), **flat)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"step": step, "keys": sorted(flat)}, f)
+        self._commit(tmp, step)
+
+    # Shared filesystem pieces — one definition each, so the sync and async
+    # writers cannot drift in layout.
+    def _fresh_tmp(self, step: int) -> str:
+        tmp = os.path.join(self.directory, f"ckpt_{step:08d}.tmp")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        return tmp
+
+    def _commit(self, tmp: str, step: int) -> None:
+        final = os.path.join(self.directory, f"ckpt_{step:08d}")
         if os.path.exists(final):
             shutil.rmtree(final)
         os.replace(tmp, final)
         self._rotate()
+
+    @staticmethod
+    def _shard_file(tmp: str, proc: int) -> str:
+        return os.path.join(tmp, f"shards_p{proc:05d}.npz")
+
+    def _write_sharded_meta(
+        self, tmp: str, meta_arrays: dict[str, dict], step: int, nproc: int
+    ) -> None:
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(
+                {
+                    "step": step,
+                    "format": "sharded-v1",
+                    "n_processes": nproc,
+                    "arrays": meta_arrays,
+                },
+                f,
+            )
 
     def _save_sharded(self, state: Any, step: int) -> str:
         """Every process writes its addressable shards; no full-array gather.
@@ -166,11 +193,38 @@ class CheckpointManager:
                 multihost_utils.sync_global_devices(f"ckpt_{step}_{tag}")
 
         if self.is_primary:
-            if os.path.exists(tmp):
-                shutil.rmtree(tmp)
-            os.makedirs(tmp, exist_ok=True)
+            self._fresh_tmp(step)
         barrier("tmp_ready")
 
+        entries, meta_arrays = self._collect_shard_entries(state)
+        np.savez(self._shard_file(tmp, proc), **entries)
+        if self.is_primary:
+            self._write_sharded_meta(tmp, meta_arrays, step, nproc)
+        barrier("shards_written")
+        if self.is_primary:
+            self._commit(tmp, step)
+        # No process may report the save durable before the rename commits —
+        # otherwise a peer could see "saved step N" for a checkpoint that a
+        # primary crash leaves uncommitted.
+        barrier("committed")
+        return final
+
+    def _collect_shard_entries(
+        self, state: Any
+    ) -> tuple[dict[str, np.ndarray], dict[str, dict]]:
+        """Device -> host snapshot of this process's addressable shards (one
+        replica of each distinct slice) plus, on the primary, the per-array
+        meta. The device-read half of a sharded save, shared by the sync path
+        and ``AsyncCheckpointManager``."""
+        # Kick off all device->host copies first so the blocking np.asarray
+        # pass below overlaps DMA across shards instead of serializing them.
+        for leaf in jax.tree_util.tree_leaves(state):
+            if _is_distributed(leaf):
+                for shard in leaf.addressable_shards:
+                    if shard.replica_id == 0:
+                        shard.data.copy_to_host_async()
+            elif isinstance(leaf, jax.Array) and self.is_primary:
+                leaf.copy_to_host_async()
         entries: dict[str, np.ndarray] = {}
         meta_arrays: dict[str, dict] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
@@ -196,29 +250,7 @@ class CheckpointManager:
                         leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype
                     ),
                 }
-        np.savez(os.path.join(tmp, f"shards_p{proc:05d}.npz"), **entries)
-        if self.is_primary:
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump(
-                    {
-                        "step": step,
-                        "format": "sharded-v1",
-                        "n_processes": nproc,
-                        "arrays": meta_arrays,
-                    },
-                    f,
-                )
-        barrier("shards_written")
-        if self.is_primary:
-            if os.path.exists(final):
-                shutil.rmtree(final)
-            os.replace(tmp, final)
-            self._rotate()
-        # No process may report the save durable before the rename commits —
-        # otherwise a peer could see "saved step N" for a checkpoint that a
-        # primary crash leaves uncommitted.
-        barrier("committed")
-        return final
+        return entries, meta_arrays
 
     def _rotate(self) -> None:
         steps = self.all_steps()
@@ -387,13 +419,13 @@ class AsyncCheckpointManager(CheckpointManager):
     resumes after the snapshot (device-to-host DMA) instead of stalling on
     disk I/O, which dominates for multi-GB states.
 
-    Falls back to the synchronous path when the state is device-sharded
-    across processes: the sharded protocol runs collective barriers
-    (``_save_sharded``), and collectives from a background thread would race
-    the training step's own collectives for device-order and deadlock.
-    Single-process sharded states (one host, several chips) carry the same
-    hazard — ``sync_global_devices`` is skipped there, but the shard reads
-    are device ops — so they too save synchronously.
+    Sharded states on a SINGLE process (one host, several chips — the
+    common fsdp-on-one-board case) also write async: the shard reads are
+    device->host copies done synchronously here, and the npz/rename/rotate
+    goes to the worker. Only MULTI-process sharded states fall back to the
+    fully synchronous path: their protocol runs collective barriers
+    (``_save_sharded``), and collectives from a background thread would
+    race the training step's own collectives for device-order and deadlock.
 
     ``wait()`` drains the queue; the trainer calls it before reporting a
     preemption save durable and at the end of ``fit``. A worker failure
@@ -412,19 +444,41 @@ class AsyncCheckpointManager(CheckpointManager):
     def save(self, state: Any, step: int | None = None) -> str | None:
         step = int(state.step) if step is None else int(step)
         leaves = jax.tree_util.tree_leaves(state)
-        if any(_is_distributed(l) for l in leaves):
+        sharded = any(_is_distributed(l) for l in leaves)
+        if sharded and jax.process_count() > 1:
             return super().save(state, step)  # sync: see class docstring
         self.wait()  # one write in flight at a time; surface prior failures
         if not self.is_primary:
+            # Misconfigured single-process secondary: writing would commit a
+            # checkpoint whose replicated leaves/meta were skipped (and
+            # rotate away good ones). The sync multi-process path is the only
+            # one where non-primary saves participate.
             return None
+        final = os.path.join(self.directory, f"ckpt_{step:08d}")
+        if sharded:
+            entries, meta_arrays = self._collect_shard_entries(state)
+            self._pending = self._executor.submit(
+                self._write_sharded_single, entries, meta_arrays, step
+            )
+            return final
         # Overlap the device->host copies across leaves, then materialize.
         for leaf in leaves:
             if isinstance(leaf, jax.Array):
                 leaf.copy_to_host_async()
         flat = _flatten(state)
-        final = os.path.join(self.directory, f"ckpt_{step:08d}")
         self._pending = self._executor.submit(self._write_replicated, flat, step)
         return final
+
+    def _write_sharded_single(
+        self, entries: dict[str, np.ndarray], meta_arrays: dict[str, dict], step: int
+    ) -> None:
+        """Single-process sharded commit (worker thread): one shard file +
+        meta, atomic rename, rotation — the filesystem half of
+        ``_save_sharded`` (shared helpers) without the barriers."""
+        tmp = self._fresh_tmp(step)
+        np.savez(self._shard_file(tmp, 0), **entries)
+        self._write_sharded_meta(tmp, meta_arrays, step, nproc=1)
+        self._commit(tmp, step)
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) has committed; re-raises
