@@ -135,13 +135,20 @@ def make_sharded_steps(
 
     A mesh with ``pipe > 1`` swaps in the GPipe-pipelined forward; all other
     axes keep the plain SPMD-sharded step."""
-    if model_cfg.moe_experts and mesh.shape.get("pipe", 1) > 1:
-        # Guarded here (the public entry point; DistributedTrainer reaches it
-        # too): the GPipe forward neither stacks heterogeneous layer params
-        # (moe_every > 1) nor collects the load-balance loss, and the metrics
-        # shardings below would mismatch the aux-less pipelined step.
+    if (
+        model_cfg.moe_experts
+        and model_cfg.moe_every > 1
+        and mesh.shape.get("pipe", 1) > 1
+    ):
+        # Homogeneous MoE stacks (moe_every == 1) pipeline fine — layer
+        # params stack and the aux loss rides the schedule
+        # (pipeline_apply(with_aux=True)). A mixed dense/MoE stack has
+        # per-layer trees of different SHAPE, which stack_layer_params
+        # cannot stack.
         raise ValueError(
-            "pipe>1 with a MoE model is not yet wired through the GPipe path"
+            "pipe>1 requires a homogeneous layer stack: set moe_every=1 "
+            "(every layer MoE) — mixed dense/MoE stacks cannot stack over "
+            "the pipe axis"
         )
     ep = mesh.shape.get("expert", 1)
     if ep > 1 and model_cfg.moe_experts % ep:
@@ -235,10 +242,10 @@ class DistributedTrainer(Trainer):
             )
         n_stages = mesh.shape.get("pipe", 1)
         if n_stages > 1:
-            # (MoE+pipe is rejected by make_sharded_steps, reached below.)
+            # (Heterogeneous-MoE+pipe is rejected by make_sharded_steps.)
             unsupported = {
                 a: mesh.shape[a]
-                for a in ("model", "seq")
+                for a in ("model", "seq", "expert")
                 if mesh.shape.get(a, 1) > 1
             }
             if unsupported:
@@ -246,8 +253,9 @@ class DistributedTrainer(Trainer):
                     f"pipe>1 composes with 'data' and 'fsdp' (stage params "
                     "stay fsdp-sharded at rest and gather per layer — "
                     f"parallel/pipeline.py), but not yet with {unsupported}: "
-                    "tensor/sequence sharding inside stages is not wired "
-                    "through the GPipe path."
+                    "tensor/sequence/expert sharding inside stages is not "
+                    "wired through the GPipe path (expert_mesh constraints "
+                    "cannot fire inside its shard_map)."
                 )
             if model_cfg.num_layers % n_stages:
                 raise ValueError(

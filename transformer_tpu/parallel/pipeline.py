@@ -85,7 +85,8 @@ def pipeline_apply(
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
     param_specs: Params | None = None,
     fsdp_axis: str = "fsdp",
-) -> jax.Array:
+    with_aux: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run a homogeneous layer stack over ``x`` with the GPipe schedule.
 
     Args:
@@ -93,6 +94,8 @@ def pipeline_apply(
         ``num_layers`` (the ``pipe`` mesh axis size must divide it).
       layer_fn: ``layer_fn(layer_params, x, rng, *consts) -> x`` applying ONE
         layer; ``rng`` is None when ``base_rng`` is None (deterministic).
+        With ``with_aux=True`` the contract is ``-> (x, aux_scalar)`` instead
+        (e.g. a MoE layer's load-balance loss).
       x: ``(B, ...)`` activations (e.g. post-embedding ``(B, S, D)``).
       mb_consts: per-example side inputs streamed with the schedule (masks,
         cross-attention memory) — each ``(B, ...)``, microbatched like ``x``.
@@ -105,7 +108,11 @@ def pipeline_apply(
         those leaves stay sharded at rest and are gathered per layer inside
         the stage scan. None = stages hold their layers whole.
 
-    Returns ``(B, ...)`` outputs, replicated over ``pipe``.
+    Returns ``(B, ...)`` outputs, replicated over ``pipe`` — plus, with
+    ``with_aux``, a replicated fp32 scalar: the per-layer aux losses summed
+    over layers, averaged over microbatches and batch shards (aux is a batch
+    statistic, so the pipelined value is the mean of per-microbatch values —
+    the same approximation gradient accumulation makes).
     """
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     n_stages = mesh.shape[axis]
@@ -113,6 +120,7 @@ def pipeline_apply(
         raise ValueError(
             f"pipe axis size {n_stages} must divide num_layers {num_layers}"
         )
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
 
     if param_specs is None:
         params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
@@ -133,7 +141,7 @@ def pipeline_apply(
         shard_map,
         mesh=mesh,
         in_specs=(params_spec, bspec, consts_spec, rng_spec),
-        out_specs=bspec,
+        out_specs=(bspec, P()) if with_aux else bspec,
         check_vma=False,
     )
     def _pipelined(local_params, x_local, consts_local, rng):
@@ -163,32 +171,50 @@ def pipeline_apply(
                     r = jax.random.fold_in(
                         jax.random.fold_in(rng, global_layer), mb_idx
                     )
-                return layer_fn(lp, h, r, *consts_mb), None
+                out = layer_fn(lp, h, r, *consts_mb)
+                if with_aux:
+                    h, aux = out
+                    return h, jnp.asarray(aux, jnp.float32)
+                return out, jnp.float32(0.0)
 
-            h, _ = jax.lax.scan(
+            h, layer_aux = jax.lax.scan(
                 one_layer, h, (jnp.arange(layers_per_stage), local_params)
             )
-            return h
+            return h, jnp.sum(layer_aux)
 
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-        def tick(buf, t):
+        def tick(carry, t):
+            buf, aux_acc = carry
             mb_idx = jnp.clip(t - stage, 0, M - 1)
             inp = jnp.where(stage == 0, x_mbs[jnp.clip(t, 0, M - 1)], buf)
-            out = apply_stage(inp, mb_idx)
+            out, aux = apply_stage(inp, mb_idx)
+            # Only ticks where this stage holds a REAL microbatch contribute
+            # aux (warm-up/drain ticks process in-flight garbage).
+            valid = jnp.logical_and(t >= stage, t - stage < M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             if n_stages > 1:
                 nxt = jax.lax.ppermute(out, axis, fwd_perm)
             else:
                 nxt = out
-            return nxt, out
+            return (nxt, aux_acc), out
 
-        _, outs = jax.lax.scan(tick, jnp.zeros_like(x_mbs[0]), jnp.arange(T))
+        (_, aux_acc), outs = jax.lax.scan(
+            tick, (jnp.zeros_like(x_mbs[0]), jnp.float32(0.0)), jnp.arange(T)
+        )
         # outs[t] on the last stage holds microbatch t-(P-1); earlier stages
         # hold in-flight garbage. Select + broadcast.
         result = outs[n_stages - 1 :]
         is_last = (stage == n_stages - 1).astype(result.dtype)
         result = jax.lax.psum(result * is_last, axis)
-        return result.reshape(batch, *x_local.shape[1:])
+        result = result.reshape(batch, *x_local.shape[1:])
+        if not with_aux:
+            return result
+        # Sum over stages (each stage saw its own layers), mean over
+        # microbatches, mean over batch shards -> one replicated scalar.
+        aux = jax.lax.psum(aux_acc, axis) / M
+        aux = jax.lax.pmean(aux, batch_axes)
+        return result, aux
 
     return _pipelined(stacked_params, x, mb_consts, base_rng if base_rng is not None else jax.random.PRNGKey(0))
 
@@ -225,7 +251,7 @@ def pipelined_transformer_apply(
     rng: jax.Array | None = None,
     deterministic: bool = True,
     pad_id: int = 0,
-) -> jax.Array:
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Pipeline-parallel counterpart of ``models.transformer.transformer_apply``
     (same logits, no attention-weight plumbing): embedding prologue and final
     projection run replicated on every stage (they are tiny next to the layer
@@ -233,6 +259,11 @@ def pipelined_transformer_apply(
 
     Layer params are stacked on entry — callers that jit this (they should)
     pay that restructuring once at trace time.
+
+    MoE models (``cfg.moe_experts > 0``, homogeneous stacks only —
+    ``moe_every == 1``) return ``(logits, moe_aux)`` instead of bare logits:
+    the layers' load-balance losses ride the schedule as a second scan
+    output (``pipeline_apply(with_aux=True)``).
     """
     from transformer_tpu.models.decoder import decoder_layer_apply
     from transformer_tpu.models.encoder import embed_prologue, encoder_layer_apply
@@ -245,6 +276,8 @@ def pipelined_transformer_apply(
     else:
         r_embed_e, r_embed_d, r_enc, r_dec = jax.random.split(rng, 4)
 
+    moe = bool(cfg.moe_experts)
+
     if cfg.decoder_only:
         self_mask = make_padding_mask(tar, pad_id)
         x = embed_prologue(
@@ -253,9 +286,10 @@ def pipelined_transformer_apply(
         stacked = stack_layer_params(params["decoder"]["layers"])
 
         def dec_layer(lp, h, r, smask):
-            return decoder_layer_apply(
+            out = decoder_layer_apply(
                 lp, h, None, smask, None, cfg, r, deterministic
-            )[0]
+            )
+            return (out[0], out[4]) if moe else out[0]
 
         if cfg.remat:
             dec_layer = jax.checkpoint(dec_layer)
@@ -263,12 +297,16 @@ def pipelined_transformer_apply(
             stacked, dec_layer, x, (self_mask,),
             mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
             param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
+            with_aux=moe,
         )
+        if moe:
+            x, aux = x
         if cfg.norm_scheme == "pre":
             x = layernorm_apply(
                 params["decoder"]["final_ln"], x, cfg.layernorm_epsilon
             )
-        return _logits(params, x, cfg)
+        logits = _logits(params, x, cfg)
+        return (logits, aux) if moe else logits
 
     enc_mask = make_padding_mask(inp, pad_id)
     self_mask = make_padding_mask(tar, pad_id)
@@ -279,7 +317,8 @@ def pipelined_transformer_apply(
     enc_stacked = stack_layer_params(params["encoder"]["layers"])
 
     def enc_layer(lp, h, r, mask):
-        return encoder_layer_apply(lp, h, mask, cfg, r, deterministic)[0]
+        out = encoder_layer_apply(lp, h, mask, cfg, r, deterministic)
+        return (out[0], out[2]) if moe else out[0]
 
     if cfg.remat:
         # Same activation-memory lever as the sequential path (encoder_apply /
@@ -290,7 +329,11 @@ def pipelined_transformer_apply(
         enc_stacked, enc_layer, x, (enc_mask,),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_enc,
         param_specs=_layer_fsdp_specs(params["encoder"]["layers"][0], mesh),
+        with_aux=moe,
     )
+    enc_aux = None
+    if moe:
+        enc_out, enc_aux = enc_out
     if cfg.norm_scheme == "pre":
         enc_out = layernorm_apply(
             params["encoder"]["final_ln"], enc_out, cfg.layernorm_epsilon
@@ -302,9 +345,10 @@ def pipelined_transformer_apply(
     dec_stacked = stack_layer_params(params["decoder"]["layers"])
 
     def dec_layer(lp, h, r, enc_mb, smask, cmask):
-        return decoder_layer_apply(
+        out = decoder_layer_apply(
             lp, h, enc_mb, smask, cmask, cfg, r, deterministic
-        )[0]
+        )
+        return (out[0], out[4]) if moe else out[0]
 
     if cfg.remat:
         dec_layer = jax.checkpoint(dec_layer)
@@ -312,9 +356,13 @@ def pipelined_transformer_apply(
         dec_stacked, dec_layer, y, (enc_out, self_mask, enc_mask),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
         param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
+        with_aux=moe,
     )
+    if moe:
+        y, dec_aux = y
     if cfg.norm_scheme == "pre":
         y = layernorm_apply(
             params["decoder"]["final_ln"], y, cfg.layernorm_epsilon
         )
-    return _logits(params, y, cfg)
+    logits = _logits(params, y, cfg)
+    return (logits, enc_aux + dec_aux) if moe else logits
