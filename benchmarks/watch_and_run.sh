@@ -20,6 +20,7 @@ LOG=watch_tpu.log
 ROWS=bench_r2_rows.jsonl
 ATTR=bench_r2_attr.jsonl
 BLEU=bleu_r2.json
+EXTRA=bench_r2_extras.jsonl
 log() { echo "$(date +%F_%T) $*" >>"$LOG"; }
 
 missing_rows() {
@@ -44,6 +45,18 @@ missing_attr() {
 
 bleu_missing() { ! grep -q '"bleu"' "$BLEU" 2>/dev/null; }
 
+missing_extras() {
+  # Optional perf A/Bs for the MFU analysis, captured only after the
+  # required measurements: chunked-CE vs monolithic on base, and a
+  # batch-256 MFU-ceiling probe. Items are the metric-tag suffixes.
+  local out=""
+  grep -qF '"metric": "base train throughput [chunks=4]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,chunks=4"
+  grep -qF '"metric": "base train throughput [b256xs64]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,b256xs64"
+  echo "${out#,}"
+}
+
 pick_least_failed() {
   # args: jsonl-file, metric-suffix-template items... — choose the item with
   # the fewest recorded "error" lines, so one persistently failing config
@@ -67,7 +80,8 @@ log "watchdog started (pid $$)"
 while :; do
   R=$(missing_rows)
   A=$(missing_attr)
-  if [ -z "$R" ] && [ -z "$A" ] && ! bleu_missing; then
+  X=$(missing_extras)
+  if [ -z "$R" ] && [ -z "$A" ] && [ -z "$X" ] && ! bleu_missing; then
     log "all measurements captured; exiting"
     break
   fi
@@ -96,10 +110,24 @@ while :; do
     log "running base attribution: $PICK"
     timeout 2400 python benchmarks/run.py --configs base --modes "$PICK" >>"$ATTR" 2>>bench_r2.err
     log "attribution pass done (rc=$?)"
-  else
+  elif bleu_missing; then
     log "running BLEU convergence (resumes from checkpoint if interrupted)"
     timeout 10800 python benchmarks/bleu_run.py --config base --epochs 40 --bleu_every 10 >>"$BLEU" 2>>bleu_r2.err
     log "BLEU pass done (rc=$?)"
+  else
+    IFS=, read -ra XARR <<<"$X"
+    PICK=$(pick_least_failed "$EXTRA" "base train throughput [%s]" "${XARR[@]}")
+    case "$PICK" in
+      "chunks=4")
+        log "running extra: base chunked-CE A/B"
+        timeout 2400 python benchmarks/run.py --configs base --loss_chunks 4 >>"$EXTRA" 2>>bench_r2.err
+        ;;
+      "b256xs64")
+        log "running extra: base batch-256 MFU probe"
+        timeout 2400 python benchmarks/run.py --configs base --batch 256 >>"$EXTRA" 2>>bench_r2.err
+        ;;
+    esac
+    log "extras pass done (rc=$?)"
   fi
   rm -f .tpu_busy
 done

@@ -209,3 +209,29 @@ def test_read_lines_strips_newlines(tmp_path):
     p = tmp_path / "f.txt"
     p.write_text("a b\nc d\n")
     assert read_lines(str(p)) == ["a b", "c d"]
+
+
+def test_dump_attention_maps(tmp_path, overfit_setup):
+    """The interpretability artifact: per-layer maps for (src, tgt) pairs,
+    trimmed to true lengths, rows summing to 1 (softmax)."""
+    from transformer_tpu.train.evaluate import dump_attention_maps
+
+    params, cfg, tok = overfit_setup
+    out = str(tmp_path / "attn.npz")
+    n = dump_attention_maps(
+        params, cfg, tok, tok,
+        [SENTENCES[0], SENTENCES[1]], [SENTENCES[0], SENTENCES[1]], out,
+    )
+    assert n == 2
+    with np.load(out) as z:
+        names = set(z.files)
+        assert "s0/src_ids" in names and "s1/tgt_ids" in names
+        assert "s0/encoder_layer1" in names
+        assert "s0/decoder_layer1_block1" in names
+        assert "s0/decoder_layer1_block2" in names
+        enc = z["s0/encoder_layer1"]  # (H, S_src, S_src)
+        s_src = len(z["s0/src_ids"])
+        assert enc.shape == (cfg.num_heads, s_src, s_src)
+        np.testing.assert_allclose(enc.sum(-1), 1.0, atol=1e-5)
+        cross = z["s0/decoder_layer1_block2"]  # (H, S_tgt, S_src)
+        assert cross.shape == (cfg.num_heads, len(z["s0/tgt_ids"]), s_src)

@@ -26,6 +26,13 @@ def define_translate_flags() -> None:
     flags.DEFINE_string("sentences", "", "';'-separated sentences (default: stdin lines)")
     flags.DEFINE_integer("max_len", 64, "max generated tokens per sentence")
     flags.DEFINE_integer("beam", 1, "beam size (1 = greedy)")
+    flags.DEFINE_string(
+        "attention_out", "",
+        "dump per-layer attention maps to this .npz: a teacher-forced "
+        "forward over (source, translation) saves encoder self-attention "
+        "and decoder self/cross maps per sentence — the reference's "
+        "attention_weights return (Transformer.py:30-32) as a servable "
+        "artifact ('' = off)")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
 
 
@@ -75,6 +82,14 @@ def main(argv) -> None:
     )
     for out in outputs:
         print(out)
+    if FLAGS.attention_out:
+        from transformer_tpu.train.evaluate import dump_attention_maps
+
+        n = dump_attention_maps(
+            params, model_cfg, src_tok, tgt_tok, sentences, outputs,
+            FLAGS.attention_out,
+        )
+        logging.info("wrote %d attention maps to %s", n, FLAGS.attention_out)
 
 
 def run() -> None:

@@ -62,6 +62,61 @@ def read_lines(path: str) -> list[str]:
         return [line.rstrip("\n") for line in f]
 
 
+def dump_attention_maps(
+    params,
+    model_cfg: ModelConfig,
+    src_tok,
+    tgt_tok,
+    src_sentences: list[str],
+    tgt_sentences: list[str],
+    out_path: str,
+) -> int:
+    """Save per-layer attention maps for (source, target) sentence pairs.
+
+    The reference returns every layer's attention weights from the forward
+    pass as its interpretability surface (``Transformer.py:30-32``,
+    ``Decoder.py:75-76``); here the same maps become a servable artifact: a
+    teacher-forced forward per pair with ``return_weights=True``, written as
+    one ``.npz`` with entries ``s{i}/<map-name>`` (encoder_layer{L},
+    decoder_layer{L}_block{1,2}) plus the token ids, trimmed to the pair's
+    true lengths. For ``decoder_only`` models only target-side self-attention
+    exists; ``src_ids`` is omitted since the source never enters the forward.
+    Flash/ring attention impls materialize no weight maps — only the ids are
+    written then. Returns the number of pairs written."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transformer_tpu.models import transformer_apply
+
+    if len(src_sentences) != len(tgt_sentences):
+        raise ValueError(
+            f"source/target sentence counts differ: {len(src_sentences)} != "
+            f"{len(tgt_sentences)}"
+        )
+    arrays: dict[str, np.ndarray] = {}
+    cap = model_cfg.max_position
+    for i, (src, tgt) in enumerate(zip(src_sentences, tgt_sentences)):
+        # Clip to the positional table: a max_len-long translation plus
+        # BOS/EOS can exceed max_position (maps stay interpretable, the
+        # tail is simply not plotted).
+        src_ids = [src_tok.bos_id, *src_tok.encode(src), src_tok.eos_id][:cap]
+        tgt_ids = [tgt_tok.bos_id, *tgt_tok.encode(tgt), tgt_tok.eos_id][:cap]
+        s = jnp.asarray([src_ids], jnp.int32)
+        t = jnp.asarray([tgt_ids], jnp.int32)
+        _, attn = transformer_apply(
+            params, None if model_cfg.decoder_only else s, t, model_cfg,
+            deterministic=True, return_weights=True,
+        )
+        if not model_cfg.decoder_only:
+            arrays[f"s{i}/src_ids"] = np.asarray(src_ids, np.int32)
+        arrays[f"s{i}/tgt_ids"] = np.asarray(tgt_ids, np.int32)
+        for name, w in attn.items():
+            if hasattr(w, "ndim") and w.ndim == 4:  # (1, H, S_q, S_k) maps
+                arrays[f"s{i}/{name}"] = np.asarray(w[0], np.float32)
+    np.savez(out_path, **arrays)
+    return len(src_sentences)
+
+
 def bleu_on_test_files(
     params,
     model_cfg: ModelConfig,
