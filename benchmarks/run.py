@@ -266,9 +266,16 @@ def main() -> None:
         )
     except Exception as e:  # record the failure as a JSON line
         # Same tag as the success path, so failures attribute to the right
-        # mode/variant in the rows file.
-        tag = (f" [{mode}]" if mode != "full" else "") + (
-            f" [chunks={args.loss_chunks}]" if args.loss_chunks > 1 else ""
+        # mode/variant in the rows file (the watchdog's least-failed
+        # selection greps these exact strings).
+        shapes = ""
+        if args.batch or args.seq:
+            b, s = _configs()[name][2:]
+            shapes = f" [b{args.batch or b}xs{args.seq or s}]"
+        tag = (
+            (f" [{mode}]" if mode != "full" else "")
+            + (f" [chunks={args.loss_chunks}]" if args.loss_chunks > 1 else "")
+            + shapes
         )
         print(
             json.dumps(

@@ -45,6 +45,22 @@ missing_attr() {
 
 bleu_missing() { ! grep -q '"bleu"' "$BLEU" 2>/dev/null; }
 
+extras_done_or_exhausted() {
+  # Extras are OPTIONAL: they must not keep the watchdog alive forever.
+  # Done, or every still-missing extra has already failed twice.
+  local x c n metric
+  x=$(missing_extras)
+  [ -z "$x" ] && return 0
+  IFS=, read -ra _xarr <<<"$x"
+  for c in "${_xarr[@]}"; do
+    metric="base train throughput [$c]"
+    n=$(grep -cF "\"metric\": \"$metric\", \"error\"" "$EXTRA" 2>/dev/null || true)
+    n=${n:-0}
+    [ "$n" -ge 2 ] || return 1
+  done
+  return 0
+}
+
 missing_extras() {
   # Optional perf A/Bs for the MFU analysis, captured only after the
   # required measurements: chunked-CE vs monolithic on base, and a
@@ -81,8 +97,8 @@ while :; do
   R=$(missing_rows)
   A=$(missing_attr)
   X=$(missing_extras)
-  if [ -z "$R" ] && [ -z "$A" ] && [ -z "$X" ] && ! bleu_missing; then
-    log "all measurements captured; exiting"
+  if [ -z "$R" ] && [ -z "$A" ] && ! bleu_missing && extras_done_or_exhausted; then
+    log "all measurements captured (or extras exhausted); exiting"
     break
   fi
   if ! ss -tln | grep -q ':8082 '; then

@@ -1,0 +1,59 @@
+"""CLI flag materialization: --preset folds BASELINE configs into unset
+flags; explicitly-passed flags always win. Runs in subprocesses because absl
+flags are process-global (a second define_flags() would collide)."""
+
+import os
+import subprocess
+import sys
+
+_SNIPPET = """
+import sys
+from absl import flags
+from transformer_tpu.cli.flags import (
+    define_flags, flags_to_model_config, flags_to_train_config,
+)
+define_flags()
+flags.FLAGS(sys.argv)
+m = flags_to_model_config(100, 100)
+t = flags_to_train_config()
+print(m.num_layers, m.d_model, m.dff, m.num_heads, m.tie_embeddings,
+      m.decoder_only, m.attention_impl, t.label_smoothing, t.sequence_length,
+      t.batch_size)
+"""
+
+
+def _materialize(*argv: str) -> list[str]:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _SNIPPET, *argv],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout.strip().split()
+
+
+def test_no_preset_keeps_reference_defaults():
+    vals = _materialize()
+    assert vals == [
+        "4", "512", "1024", "4", "False", "False", "xla", "0.0", "50", "64"
+    ]
+
+
+def test_preset_big_applies():
+    vals = _materialize("--preset=big")
+    assert vals[:4] == ["6", "1024", "4096", "16"]
+    assert vals[7] == "0.1"  # label smoothing comes with the big config
+    assert vals[9] == "32"  # and the benchmark's batch size
+
+
+def test_explicit_flag_beats_preset():
+    vals = _materialize("--preset=big", "--dff=1234")
+    assert vals[2] == "1234"
+    assert vals[1] == "1024"  # the rest of the preset still lands
+
+
+def test_preset_long4k_is_decoder_only_flash():
+    vals = _materialize("--preset=long4k")
+    assert vals[5] == "True" and vals[6] == "flash"
+    assert vals[8] == "4096" and vals[9] == "4"

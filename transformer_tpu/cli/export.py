@@ -28,6 +28,9 @@ def define_export_flags() -> None:
 
 def main(argv) -> None:
     del argv
+    from transformer_tpu.cli.flags import apply_preset
+
+    apply_preset()  # before ANY direct FLAGS read (e.g. decoder_only)
     import jax
 
     jax.config.update("jax_platforms", FLAGS.platform or "cpu")

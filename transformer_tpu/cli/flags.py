@@ -19,7 +19,42 @@ from transformer_tpu.config import MeshConfig, ModelConfig, TrainConfig
 FLAGS = flags.FLAGS
 
 
+# One-flag reproduction of the BASELINE.json benchmark configs: values land
+# on flags the user did NOT set explicitly (explicit flags always win).
+_PRESETS: dict[str, dict] = {
+    "tiny": dict(num_layers=2, d_model=128, num_heads=4, dff=512, batch_size=64),
+    "base": dict(num_layers=6, d_model=512, num_heads=8, dff=2048, batch_size=64),
+    "big": dict(
+        num_layers=6, d_model=1024, num_heads=16, dff=4096,
+        label_smoothing=0.1, batch_size=32,
+    ),
+    "tied": dict(
+        num_layers=6, d_model=512, num_heads=8, dff=2048,
+        tie_embeddings=True, tie_output=True, batch_size=64,
+    ),
+    "long4k": dict(
+        num_layers=6, d_model=512, num_heads=8, dff=2048,
+        decoder_only=True, attention_impl="flash", sequence_length=4096,
+        remat=True, batch_size=4,
+    ),
+}
+
+
+def apply_preset() -> None:
+    """Fold ``--preset`` values into unset flags (idempotent; called by the
+    flags_to_* materializers so every CLI gets it)."""
+    if not FLAGS.preset:
+        return
+    for name, value in _PRESETS[FLAGS.preset].items():
+        if not FLAGS[name].present:
+            setattr(FLAGS, name, value)
+
+
 def define_flags() -> None:
+    flags.DEFINE_enum(
+        "preset", "", ["", *sorted(_PRESETS)],
+        "start from a BASELINE benchmark config (tiny/base/big/tied/long4k); "
+        "explicitly-passed flags override preset values")
     # --- reference-surface flags (utils.py:18-33 defaults) ---
     flags.DEFINE_string("dataset_path", "data", "directory with src/tgt line files")
     flags.DEFINE_integer("buffer_size", 100000, "shuffle buffer (compat; full-shuffle used)")
@@ -135,6 +170,7 @@ def define_flags() -> None:
 
 
 def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> ModelConfig:
+    apply_preset()
     return ModelConfig(
         num_layers=FLAGS.num_layers,
         d_model=FLAGS.d_model,
@@ -162,6 +198,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
 
 
 def flags_to_train_config() -> TrainConfig:
+    apply_preset()
     return TrainConfig(
         batch_size=FLAGS.batch_size,
         sequence_length=FLAGS.sequence_length,

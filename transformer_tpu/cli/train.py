@@ -30,6 +30,9 @@ FLAGS = flags.FLAGS
 
 def main(argv) -> None:
     del argv
+    from transformer_tpu.cli.flags import apply_preset
+
+    apply_preset()  # before ANY direct FLAGS read (e.g. decoder_only)
     maybe_force_platform()
     import jax
 
