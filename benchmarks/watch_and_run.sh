@@ -45,18 +45,30 @@ missing_attr() {
 
 bleu_missing() { ! grep -q '"bleu"' "$BLEU" 2>/dev/null; }
 
+error_count() {
+  # Recorded "error" lines for one metric in one jsonl file (0 when the
+  # file does not exist yet). -F: metric text contains [].
+  local n
+  n=$(grep -cF "\"metric\": \"$1\", \"error\"" "$2" 2>/dev/null || true)
+  echo "${n:-0}"
+}
+
+record_failure() {
+  # Append a synthetic error line when a measurement subprocess died without
+  # reaching run.py's own error handler (timeout kill, OOM, segfault) —
+  # otherwise exhaustion/least-failed accounting never sees the attempt.
+  echo "{\"metric\": \"$1\", \"error\": \"watchdog: subprocess rc=$3\"}" >>"$2"
+}
+
 extras_done_or_exhausted() {
   # Extras are OPTIONAL: they must not keep the watchdog alive forever.
   # Done, or every still-missing extra has already failed twice.
-  local x c n metric
+  local x c
   x=$(missing_extras)
   [ -z "$x" ] && return 0
   IFS=, read -ra _xarr <<<"$x"
   for c in "${_xarr[@]}"; do
-    metric="base train throughput [$c]"
-    n=$(grep -cF "\"metric\": \"$metric\", \"error\"" "$EXTRA" 2>/dev/null || true)
-    n=${n:-0}
-    [ "$n" -ge 2 ] || return 1
+    [ "$(error_count "base train throughput [$c]" "$EXTRA")" -ge 2 ] || return 1
   done
   return 0
 }
@@ -82,9 +94,7 @@ pick_least_failed() {
   for c in "$@"; do
     # shellcheck disable=SC2059
     metric=$(printf "$tmpl" "$c")
-    # -F: the metric text contains [] which grep would treat as a char class.
-    n=$(grep -cF "\"metric\": \"$metric\", \"error\"" "$file" 2>/dev/null || true)
-    n=${n:-0}  # missing file: grep prints nothing, not 0
+    n=$(error_count "$metric" "$file")
     if [ "$best_n" -lt 0 ] || [ "$n" -lt "$best_n" ]; then
       best="$c"; best_n="$n"
     fi
@@ -119,13 +129,17 @@ while :; do
     PICK=$(pick_least_failed "$ROWS" "%s train throughput" "${RARR[@]}")
     log "running throughput row: $PICK"
     timeout 2400 python benchmarks/run.py --configs "$PICK" >>"$ROWS" 2>>bench_r2.err
-    log "row pass done (rc=$?)"
+    rc=$?
+    [ "$rc" -ne 0 ] && record_failure "$PICK train throughput" "$ROWS" "$rc"
+    log "row pass done (rc=$rc)"
   elif [ -n "$A" ]; then
     IFS=, read -ra AARR <<<"$A"
     PICK=$(pick_least_failed "$ATTR" "base train throughput [%s]" "${AARR[@]}")
     log "running base attribution: $PICK"
     timeout 2400 python benchmarks/run.py --configs base --modes "$PICK" >>"$ATTR" 2>>bench_r2.err
-    log "attribution pass done (rc=$?)"
+    rc=$?
+    [ "$rc" -ne 0 ] && record_failure "base train throughput [$PICK]" "$ATTR" "$rc"
+    log "attribution pass done (rc=$rc)"
   elif bleu_missing; then
     log "running BLEU convergence (resumes from checkpoint if interrupted)"
     timeout 10800 python benchmarks/bleu_run.py --config base --epochs 40 --bleu_every 10 >>"$BLEU" 2>>bleu_r2.err
@@ -133,17 +147,21 @@ while :; do
   else
     IFS=, read -ra XARR <<<"$X"
     PICK=$(pick_least_failed "$EXTRA" "base train throughput [%s]" "${XARR[@]}")
+    rc=0
     case "$PICK" in
       "chunks=4")
         log "running extra: base chunked-CE A/B"
         timeout 2400 python benchmarks/run.py --configs base --loss_chunks 4 >>"$EXTRA" 2>>bench_r2.err
+        rc=$?
         ;;
       "b256xs64")
         log "running extra: base batch-256 MFU probe"
         timeout 2400 python benchmarks/run.py --configs base --batch 256 >>"$EXTRA" 2>>bench_r2.err
+        rc=$?
         ;;
     esac
-    log "extras pass done (rc=$?)"
+    [ "$rc" -ne 0 ] && record_failure "base train throughput [$PICK]" "$EXTRA" "$rc"
+    log "extras pass done (rc=$rc)"
   fi
   rm -f .tpu_busy
 done

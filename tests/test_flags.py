@@ -57,3 +57,33 @@ def test_preset_long4k_is_decoder_only_flash():
     vals = _materialize("--preset=long4k")
     assert vals[5] == "True" and vals[6] == "flash"
     assert vals[8] == "4096" and vals[9] == "4"
+
+
+def test_presets_match_benchmark_configs():
+    """--preset promises the BASELINE benchmark shapes; pin _PRESETS against
+    benchmarks/run.py's _configs so the two tables cannot drift."""
+    import importlib.util
+
+    from transformer_tpu.cli.flags import _PRESETS
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_run", os.path.join(repo, "benchmarks", "run.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    configs = bench._configs()
+    assert set(_PRESETS) == set(configs)
+    for name, preset in _PRESETS.items():
+        model_cfg, train_cfg, batch, seq = configs[name]
+        assert preset["num_layers"] == model_cfg.num_layers, name
+        assert preset["d_model"] == model_cfg.d_model, name
+        assert preset["num_heads"] == model_cfg.num_heads, name
+        assert preset["dff"] == model_cfg.dff, name
+        assert preset["batch_size"] == batch, name
+        assert preset.get("label_smoothing", 0.0) == train_cfg.label_smoothing, name
+        assert preset.get("tie_embeddings", False) == model_cfg.tie_embeddings, name
+        assert preset.get("decoder_only", False) == model_cfg.decoder_only, name
+        if model_cfg.decoder_only:
+            assert preset.get("attention_impl") == model_cfg.attention_impl, name
+            assert preset.get("sequence_length") == seq, name

@@ -1,0 +1,143 @@
+"""Grouped-query / multi-query attention (ModelConfig.num_kv_heads).
+
+From the retrieved-paper list (Shazeer 2019, "Fast Transformer Decoding:
+One Write-Head is All You Need"): k/v carry fewer heads than q, shrinking
+the decode KV cache and kv parameter count by num_heads/num_kv_heads. No
+reference counterpart (the reference is plain MHA, ``Attention.py:36-78``).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transformer_tpu.config import ModelConfig, TrainConfig
+from transformer_tpu.ops.attention import dot_product_attention, mha_init
+
+GQA_TINY = ModelConfig(
+    num_layers=2, d_model=32, num_heads=4, num_kv_heads=2, dff=64,
+    input_vocab_size=50, target_vocab_size=50, max_position=32,
+    dtype="float32", dropout_rate=0.0,
+)
+
+
+class TestGroupedDotProductAttention:
+    def test_grouped_equals_repeated_kv(self):
+        """The grouped einsum must equal plain MHA on kv explicitly repeated
+        to full heads — same math, no materialized repeat."""
+        B, Sq, Sk, H, Hkv, D = 2, 6, 7, 4, 2, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, Sq, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, Sk, Hkv, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, Sk, Hkv, D))
+        mask = jnp.ones((B, 1, 1, Sk), bool).at[:, :, :, -2:].set(False)
+        out_g, w_g = dot_product_attention(q, k, v, mask, return_weights=True)
+        reps = H // Hkv
+        out_r, w_r = dot_product_attention(
+            q, jnp.repeat(k, reps, axis=2), jnp.repeat(v, reps, axis=2),
+            mask, return_weights=True,
+        )
+        np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_r), atol=1e-5)
+        assert w_g.shape == (B, H, Sq, Sk)
+        np.testing.assert_allclose(np.asarray(w_g), np.asarray(w_r), atol=1e-5)
+
+    def test_kv_params_shrink(self):
+        p_mha = mha_init(jax.random.PRNGKey(0), 32, 4)
+        p_gqa = mha_init(jax.random.PRNGKey(0), 32, 4, num_kv_heads=1)
+        assert p_mha["key"]["kernel"].shape == (32, 4, 8)
+        assert p_gqa["key"]["kernel"].shape == (32, 1, 8)
+        assert p_gqa["query"]["kernel"].shape == (32, 4, 8)
+
+    def test_full_kv_heads_bitwise_matches_old_init(self):
+        """num_kv_heads == num_heads must reproduce the pre-GQA init exactly
+        (same glorot shapes and fans), so existing checkpoints stay valid."""
+        a = mha_init(jax.random.PRNGKey(3), 32, 4)
+        b = mha_init(jax.random.PRNGKey(3), 32, 4, num_kv_heads=4)
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestGqaModel:
+    def test_decode_cache_is_smaller(self):
+        from transformer_tpu.models.decoder import init_decoder_caches
+
+        caches = init_decoder_caches(GQA_TINY, batch_size=2, max_len=16)
+        assert caches[0]["k"].shape == (2, 16, 2, 8)  # kv_heads=2, not 4
+
+    def test_cached_decode_matches_full_forward(self):
+        from transformer_tpu.models import transformer_init
+        from transformer_tpu.models.decoder import init_decoder_caches
+        from transformer_tpu.models.transformer import (
+            transformer_apply,
+            transformer_decode_step,
+        )
+
+        cfg = dataclasses.replace(GQA_TINY, decoder_only=True)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray([[3, 11, 25, 7, 40, 2]], jnp.int32)
+        full_logits, _ = transformer_apply(params, None, ids, cfg)
+        caches = init_decoder_caches(cfg, batch_size=1, max_len=8)
+        for t in range(ids.shape[1]):
+            step_logits, caches = transformer_decode_step(
+                params, ids[:, t : t + 1], None, None, caches,
+                jnp.int32(t), cfg,
+            )
+            np.testing.assert_allclose(
+                np.asarray(step_logits[0]), np.asarray(full_logits[0, t]),
+                atol=2e-4,
+            )
+
+    def test_seq2seq_gqa_trains_and_translates(self):
+        from transformer_tpu.train import create_train_state, make_train_step
+        from transformer_tpu.train.decode import greedy_decode
+
+        tc = TrainConfig(batch_size=8, sequence_length=12, warmup_steps=100)
+        state = create_train_state(jax.random.PRNGKey(0), GQA_TINY, tc)
+        step = jax.jit(make_train_step(GQA_TINY, tc))
+        r = np.random.default_rng(0)
+        src = jnp.asarray(r.integers(1, 48, (8, 12)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 48, (8, 12)), jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        first = None
+        for _ in range(40):
+            state, m = step(state, src, tgt, rng)
+            first = float(m["loss"]) if first is None else first
+        assert float(m["loss"]) < first * 0.7
+        out = greedy_decode(
+            state.params, src[:2], GQA_TINY, bos_id=48, eos_id=49, max_len=6
+        )
+        assert out.shape == (2, 6)
+
+    def test_flash_matches_xla_with_gqa(self):
+        from transformer_tpu.models import transformer_apply, transformer_init
+
+        cfg = dataclasses.replace(GQA_TINY, decoder_only=True, max_position=16)
+        cfg_flash = dataclasses.replace(
+            cfg, attention_impl="flash", flash_block_q=8, flash_block_k=8
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(1, 48, (2, 16)), jnp.int32
+        )
+        la, _ = transformer_apply(params, None, ids, cfg)
+        lb, _ = transformer_apply(params, None, ids, cfg_flash)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4)
+
+    def test_rope_composes_with_gqa(self):
+        from transformer_tpu.models import transformer_apply, transformer_init
+
+        cfg = dataclasses.replace(
+            GQA_TINY, decoder_only=True, position_scheme="rope"
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        la, _ = transformer_apply(params, None, ids, cfg)
+        lb, _ = transformer_apply(params, None, ids[:, ::-1], cfg)
+        assert np.isfinite(np.asarray(la)).all()
+        assert float(jnp.max(jnp.abs(la[:, -1] - lb[:, -1]))) > 1e-4
+
+    def test_invalid_ratio_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            ModelConfig(num_heads=4, num_kv_heads=3)

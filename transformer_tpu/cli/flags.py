@@ -68,6 +68,11 @@ def define_flags() -> None:
     flags.DEFINE_integer("d_model", 512, "model width")
     flags.DEFINE_integer("dff", 1024, "FFN hidden width")
     flags.DEFINE_integer("num_heads", 4, "attention heads")
+    flags.DEFINE_integer(
+        "num_kv_heads", 0,
+        "grouped-query attention: k/v heads, each serving "
+        "num_heads/num_kv_heads query heads (smaller decode KV cache); "
+        "0 = num_heads (standard MHA)")
     flags.DEFINE_boolean("enable_function", True, "jit the train/eval steps (False = eager debug)")
     flags.DEFINE_integer("max_ckpt_keep", 5, "checkpoints to retain")
     flags.DEFINE_string("ckpt_path", "model_dist", "checkpoint directory")
@@ -175,6 +180,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         num_layers=FLAGS.num_layers,
         d_model=FLAGS.d_model,
         num_heads=FLAGS.num_heads,
+        num_kv_heads=FLAGS.num_kv_heads,
         dff=FLAGS.dff,
         input_vocab_size=input_vocab_size,
         target_vocab_size=target_vocab_size,

@@ -33,6 +33,11 @@ class ModelConfig:
     num_layers: int = 4
     d_model: int = 512
     num_heads: int = 4
+    # Grouped-query / multi-query attention (Shazeer 2019): k/v carry this
+    # many heads, each serving num_heads/num_kv_heads query heads — the
+    # decode KV cache (and kv parameter count) shrinks by that factor.
+    # 0 = num_heads (standard MHA, the reference's attention).
+    num_kv_heads: int = 0
     dff: int = 1024
     input_vocab_size: int = 32000
     target_vocab_size: int = 32000
@@ -114,10 +119,21 @@ class ModelConfig:
                 f"moe_top_k ({self.moe_top_k}) cannot exceed moe_experts "
                 f"({self.moe_experts})"
             )
+        if self.num_kv_heads < 0 or self.num_kv_heads > self.num_heads or (
+            self.num_kv_heads and self.num_heads % self.num_kv_heads
+        ):
+            raise ValueError(
+                f"num_kv_heads ({self.num_kv_heads}) must be 0 (= num_heads) "
+                f"or a positive divisor of num_heads ({self.num_heads})"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
     @property
     def compute_dtype(self) -> jnp.dtype:

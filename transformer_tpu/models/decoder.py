@@ -42,13 +42,19 @@ def decoder_layer_init(
 ) -> Params:
     k1, k2, k3 = jax.random.split(key, 3)
     params: Params = {
-        "self_mha": mha_init(k1, cfg.d_model, cfg.num_heads, cfg.params_dtype),
+        "self_mha": mha_init(
+            k1, cfg.d_model, cfg.num_heads, cfg.params_dtype,
+            num_kv_heads=cfg.kv_heads,
+        ),
         **_ffn_sublayer_init(k3, cfg, layer_uses_moe(cfg, layer_index)),
         "ln1": layernorm_init(cfg.d_model, cfg.params_dtype),
         "ln_ffn": layernorm_init(cfg.d_model, cfg.params_dtype),
     }
     if not cfg.decoder_only:
-        params["cross_mha"] = mha_init(k2, cfg.d_model, cfg.num_heads, cfg.params_dtype)
+        params["cross_mha"] = mha_init(
+            k2, cfg.d_model, cfg.num_heads, cfg.params_dtype,
+            num_kv_heads=cfg.kv_heads,
+        )
         params["ln2"] = layernorm_init(cfg.d_model, cfg.params_dtype)
     return params
 
@@ -201,7 +207,7 @@ def init_decoder_caches(
 ) -> list[dict[str, Any]]:
     """One self-attention KV cache per decoder layer."""
     return [
-        init_cache(batch_size, max_len, cfg.num_heads, cfg.head_dim, cfg.compute_dtype)
+        init_cache(batch_size, max_len, cfg.kv_heads, cfg.head_dim, cfg.compute_dtype)
         for _ in range(cfg.num_layers)
     ]
 

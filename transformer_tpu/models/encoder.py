@@ -86,7 +86,10 @@ def encoder_layer_init(
 ) -> Params:
     k_mha, k_ffn = jax.random.split(key)
     return {
-        "mha": mha_init(k_mha, cfg.d_model, cfg.num_heads, cfg.params_dtype),
+        "mha": mha_init(
+            k_mha, cfg.d_model, cfg.num_heads, cfg.params_dtype,
+            num_kv_heads=cfg.kv_heads,
+        ),
         **_ffn_sublayer_init(k_ffn, cfg, layer_uses_moe(cfg, layer_index)),
         "ln1": layernorm_init(cfg.d_model, cfg.params_dtype),
         "ln2": layernorm_init(cfg.d_model, cfg.params_dtype),

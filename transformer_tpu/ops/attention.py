@@ -40,7 +40,7 @@ def dot_product_attention(
     mask: jax.Array | None = None,
     return_weights: bool = False,
 ) -> tuple[jax.Array, jax.Array | None]:
-    """softmax(q·kᵀ/√d + bias)·v for (B, S, H, D) tensors.
+    """softmax(q·kᵀ/√d + bias)·v for (B, S, H, D) queries.
 
     Matches the math of reference ``Attention.py:20-32``. The softmax runs in
     fp32 even when inputs are bf16 — exp/sum in bf16 loses enough precision to
@@ -48,17 +48,44 @@ def dot_product_attention(
     (B, H, S_q, S_k) attention map when ``return_weights`` else None (the
     reference always returns it, ``Attention.py:32-34``; here it is opt-in so
     training never materializes the (B,H,S,S) tensor twice).
+
+    Grouped-query / multi-query attention (Shazeer 2019, "One Write-Head is
+    All You Need"): ``k``/``v`` may carry FEWER heads (B, S_k, H_kv, D) with
+    ``H % H_kv == 0`` — each kv head serves a group of ``H/H_kv`` query
+    heads. The contraction runs grouped (no materialized kv repeat).
     """
     head_dim = q.shape[-1]
     scale = head_dim**-0.5
-    # (B, S_q, H, D) x (B, S_k, H, D) -> (B, H, S_q, S_k)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    H, Hkv = q.shape[2], k.shape[2]
+    if H == Hkv:
+        # (B, S_q, H, D) x (B, S_k, H, D) -> (B, H, S_q, S_k)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        if mask is not None:
+            logits = logits + attention_bias(mask, dtype=jnp.float32)
+        weights = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(q.dtype), v)
+        return out, (weights if return_weights else None)
+
+    if H % Hkv:
+        raise ValueError(f"query heads {H} must be a multiple of kv heads {Hkv}")
+    G = H // Hkv
+    B, Sq = q.shape[:2]
+    qg = q.reshape(B, Sq, Hkv, G, head_dim)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * scale
     if mask is not None:
-        logits = logits + attention_bias(mask, dtype=jnp.float32)
+        bias = attention_bias(mask, dtype=jnp.float32)  # (B|1, H|1, S_q|1, S_k)
+        if bias.shape[1] != 1:
+            raise ValueError(
+                "per-head masks are unsupported with grouped kv heads"
+            )
+        logits = logits + bias[:, :, None]  # broadcast over (kv-head, group)
     weights = jax.nn.softmax(logits, axis=-1)
-    weights_c = weights.astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", weights_c, v)
-    return out, (weights if return_weights else None)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights.astype(q.dtype), v)
+    out = out.reshape(B, Sq, H, head_dim)
+    full_w = (
+        weights.reshape(B, H, *weights.shape[3:]) if return_weights else None
+    )
+    return out, full_w
 
 
 def mha_init(
@@ -66,22 +93,29 @@ def mha_init(
     d_model: int,
     num_heads: int,
     param_dtype=jnp.float32,
+    num_kv_heads: int | None = None,
 ) -> Params:
     """Parameters for multi-head attention: q/k/v projections shaped
     (d_model, heads, head_dim) and an output projection (heads, head_dim,
     d_model). Same parameter count as the reference's four Dense layers
-    (``Attention.py:46-50``) — just pre-split by head."""
+    (``Attention.py:46-50``) — just pre-split by head.
+
+    ``num_kv_heads < num_heads`` gives grouped-query/multi-query attention:
+    k/v kernels carry only (d_model, kv_heads, head_dim) — fewer parameters
+    and an ``H/H_kv``-times smaller decode KV cache."""
     head_dim = d_model // num_heads
+    kv_heads = num_kv_heads or num_heads
     kq, kk, kv, ko = jax.random.split(key, 4)
 
-    def proj(k):
-        w = glorot_uniform(k, (d_model, d_model), param_dtype, d_model, d_model)
-        return w.reshape(d_model, num_heads, head_dim)
+    def proj(k, heads):
+        fan_out = heads * head_dim
+        w = glorot_uniform(k, (d_model, fan_out), param_dtype, d_model, fan_out)
+        return w.reshape(d_model, heads, head_dim)
 
     return {
-        "query": {"kernel": proj(kq), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
-        "key": {"kernel": proj(kk), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
-        "value": {"kernel": proj(kv), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
+        "query": {"kernel": proj(kq, num_heads), "bias": jnp.zeros((num_heads, head_dim), param_dtype)},
+        "key": {"kernel": proj(kk, kv_heads), "bias": jnp.zeros((kv_heads, head_dim), param_dtype)},
+        "value": {"kernel": proj(kv, kv_heads), "bias": jnp.zeros((kv_heads, head_dim), param_dtype)},
         "out": {
             "kernel": glorot_uniform(ko, (d_model, d_model), param_dtype, d_model, d_model)
             .reshape(d_model, num_heads, head_dim)
@@ -193,6 +227,20 @@ def mha_apply(
         mask = valid if mask is None else jnp.logical_and(mask, valid)
         k = k.astype(dtype)
         v = v.astype(dtype)
+
+    if (
+        impl in ("flash", "ring", "ulysses")
+        and cache is None  # decode attends grouped over the small cache
+        and k.shape[2] != q.shape[2]
+    ):
+        # Grouped-query kv heads: the blockwise kernels are written for equal
+        # head counts, so repeat kv to full heads just for the kernel call.
+        # The GQA wins are kv parameter count and decode-cache size (the
+        # decode path attends grouped, no repeat); in-kernel bandwidth here
+        # matches plain MHA.
+        reps = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
 
     if impl == "flash" and cache is None:
         # Causality stays structural (a static kernel flag) so the Pallas
