@@ -188,11 +188,55 @@ class TestMultiHeadAttention:
 class TestFFN:
     def test_matches_numpy_oracle(self):
         params = ffn_init(jax.random.PRNGKey(0), 8, 16)
+        assert "gate" not in params  # ungated default matches the reference
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
         out = ffn_apply(params, x)
         h = np.maximum(np.asarray(x) @ np.asarray(params["in"]["kernel"]) + np.asarray(params["in"]["bias"]), 0)
         expected = h @ np.asarray(params["out"]["kernel"]) + np.asarray(params["out"]["bias"])
         np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+    def test_swiglu_matches_numpy_oracle(self):
+        """Gated variant (Shazeer 2020): act(x W_gate) * (x W_in) W_out."""
+        params = ffn_init(jax.random.PRNGKey(0), 8, 16, activation="swiglu")
+        assert set(params) == {"in", "out", "gate"}
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
+        out = ffn_apply(params, x, activation="swiglu")
+        xn = np.asarray(x, np.float64)
+        g = xn @ np.asarray(params["gate"]["kernel"]) + np.asarray(params["gate"]["bias"])
+        silu = g * (1.0 / (1.0 + np.exp(-g)))  # x * sigmoid(x)
+        h = silu * (xn @ np.asarray(params["in"]["kernel"]) + np.asarray(params["in"]["bias"]))
+        expected = h @ np.asarray(params["out"]["kernel"]) + np.asarray(params["out"]["bias"])
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+    def test_swiglu_model_trains(self):
+        from transformer_tpu.config import ModelConfig, TrainConfig
+        from transformer_tpu.train import create_train_state, make_train_step
+
+        cfg = ModelConfig(
+            num_layers=2, d_model=32, num_heads=4, dff=64,
+            input_vocab_size=50, target_vocab_size=50, max_position=16,
+            dtype="float32", dropout_rate=0.0, ffn_activation="swiglu",
+        )
+        tc = TrainConfig(batch_size=8, sequence_length=12, warmup_steps=100)
+        state = create_train_state(jax.random.PRNGKey(0), cfg, tc)
+        step = jax.jit(make_train_step(cfg, tc))
+        r = np.random.default_rng(0)
+        src = jnp.asarray(r.integers(1, 48, (8, 12)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 48, (8, 12)), jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        first = None
+        for _ in range(40):
+            state, m = step(state, src, tgt, rng)
+            first = float(m["loss"]) if first is None else first
+        assert float(m["loss"]) < first * 0.7
+
+    def test_moe_rejects_gated_activation(self):
+        import pytest
+
+        from transformer_tpu.config import ModelConfig
+
+        with pytest.raises(ValueError, match="ungated"):
+            ModelConfig(moe_experts=4, ffn_activation="swiglu")
 
 
 class TestLayerNorm:

@@ -92,6 +92,11 @@ def define_flags() -> None:
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
     flags.DEFINE_enum(
+        "ffn_activation", "relu",
+        ["relu", "gelu", "silu", "swiglu", "geglu", "reglu"],
+        "FFN activation (reference: relu); swiglu/geglu/reglu are the gated "
+        "three-matmul variants")
+    flags.DEFINE_enum(
         "position_scheme", "sinusoidal", ["sinusoidal", "rope"],
         "position encoding: additive sinusoidal table (reference behavior) "
         "or rotary q/k embeddings (long-context; relative positions)")
@@ -191,7 +196,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         decoder_only=FLAGS.decoder_only,
         tie_embeddings=FLAGS.tie_embeddings,
         tie_output=FLAGS.tie_output,
-        ffn_activation="relu",
+        ffn_activation=FLAGS.ffn_activation,
         dtype=FLAGS.dtype,
         attention_impl=FLAGS.attention_impl,
         remat=FLAGS.remat,

@@ -60,7 +60,9 @@ class ModelConfig:
     # BASELINE.json configs[4]: decoder-only causal LM (no encoder, no cross-attn).
     decoder_only: bool = False
     # Activation in the pointwise FFN; reference uses relu (``point_ffn.py:5``).
-    ffn_activation: str = "relu"  # "relu" | "gelu" | "silu"
+    # swiglu/geglu/reglu are the gated three-matmul variants (Shazeer 2020) —
+    # the modern-LLM FFN (dense layers only; MoE experts stay ungated).
+    ffn_activation: str = "relu"  # relu | gelu | silu | swiglu | geglu | reglu
     # Compute dtype: bf16 keeps the MXU fed at full rate; params stay fp32.
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
@@ -105,8 +107,17 @@ class ModelConfig:
                 "position_scheme='rope' needs an even head_dim "
                 f"(got {self.d_model // self.num_heads})"
             )
-        if self.ffn_activation not in ("relu", "gelu", "silu"):
+        # Single source of truth for activation names: the op registries.
+        from transformer_tpu.ops.ffn import _ACTIVATIONS, _GATED_ACTIVATIONS
+
+        if self.ffn_activation not in {**_ACTIVATIONS, **_GATED_ACTIVATIONS}:
             raise ValueError(f"unknown ffn_activation {self.ffn_activation!r}")
+        if self.moe_experts and self.ffn_activation not in _ACTIVATIONS:
+            raise ValueError(
+                "MoE experts use the ungated FFN: pick one of "
+                f"{sorted(_ACTIVATIONS)} with moe_experts > 0 "
+                f"(got {self.ffn_activation!r})"
+            )
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.moe_experts < 0 or self.moe_top_k < 1 or self.moe_every < 1:

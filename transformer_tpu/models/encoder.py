@@ -46,7 +46,12 @@ def _ffn_sublayer_init(key: jax.Array, cfg: ModelConfig, use_moe: bool) -> dict:
                 key, cfg.d_model, cfg.dff, cfg.moe_experts, cfg.params_dtype
             )
         }
-    return {"ffn": ffn_init(key, cfg.d_model, cfg.dff, cfg.params_dtype)}
+    return {
+        "ffn": ffn_init(
+            key, cfg.d_model, cfg.dff, cfg.params_dtype,
+            activation=cfg.ffn_activation,
+        )
+    }
 
 
 def _token_mask_from(mask: jax.Array | None) -> jax.Array | None:

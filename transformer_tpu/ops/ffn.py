@@ -5,6 +5,11 @@ Counterpart of the reference's ``point_wise_feed_forward_network``
 Two MXU matmuls with the activation fused between them by XLA. The ``dff``
 axis is the tensor-parallel shard axis (column-parallel first matmul,
 row-parallel second).
+
+Gated variants (Shazeer 2020, "GLU Variants Improve Transformer"):
+``swiglu``/``geglu``/``reglu`` add a third (gate) projection —
+``act(x W_gate) * (x W_in) W_out`` — the FFN used by most modern LLMs.
+Three matmuls instead of two; all still column/row-parallel on ``dff``.
 """
 
 from __future__ import annotations
@@ -20,16 +25,40 @@ _ACTIVATIONS = {
     "silu": jax.nn.silu,
 }
 
+# Gated variants: activation applied to the GATE branch.
+_GATED_ACTIVATIONS = {
+    "swiglu": jax.nn.silu,
+    "geglu": jax.nn.gelu,
+    "reglu": jax.nn.relu,
+}
 
-def ffn_init(key: jax.Array, d_model: int, dff: int, param_dtype=jnp.float32) -> Params:
-    k1, k2 = jax.random.split(key)
-    return {
+
+def is_gated(activation: str) -> bool:
+    return activation in _GATED_ACTIVATIONS
+
+
+def ffn_init(
+    key: jax.Array,
+    d_model: int,
+    dff: int,
+    param_dtype=jnp.float32,
+    activation: str = "relu",
+) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = {
         "in": dense_init(k1, d_model, dff, param_dtype),
         "out": dense_init(k2, dff, d_model, param_dtype),
     }
+    if is_gated(activation):
+        params["gate"] = dense_init(k3, d_model, dff, param_dtype)
+    return params
 
 
 def ffn_apply(params: Params, x: jax.Array, activation: str = "relu") -> jax.Array:
+    if is_gated(activation):
+        act = _GATED_ACTIVATIONS[activation]
+        h = act(dense_apply(params["gate"], x)) * dense_apply(params["in"], x)
+        return dense_apply(params["out"], h)
     act = _ACTIVATIONS[activation]
     h = act(dense_apply(params["in"], x))
     return dense_apply(params["out"], h)

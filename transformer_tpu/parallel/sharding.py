@@ -54,6 +54,8 @@ _RULES: list[tuple[str, P]] = [
     (r"out/bias$", P(None)),
     (r"ffn/in/kernel$", P("fsdp", "model")),
     (r"ffn/in/bias$", P("model")),
+    (r"ffn/gate/kernel$", P("fsdp", "model")),  # gated FFN (swiglu et al.)
+    (r"ffn/gate/bias$", P("model")),
     (r"ffn/out/kernel$", P("model", "fsdp")),
     (r"ffn/out/bias$", P(None)),
     (r"final/kernel$", P("fsdp", "model")),
