@@ -81,6 +81,33 @@ class TestPipelineApply:
         ref = self._sequential(params, x, mask)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    def test_gated_ffn_stack_matches_sequential(self):
+        """swiglu layers are homogeneous (every layer carries a gate), so
+        they stack and pipeline; forward must match the sequential stack."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, ffn_activation="swiglu")
+        mesh = _mesh(1, 4)
+        k = jax.random.PRNGKey(0)
+        params = encoder_init(k, cfg)
+        ids = _ids(jax.random.PRNGKey(1), 8, 16)
+        mask = make_padding_mask(ids, 0)
+        x = embed_prologue(params["embedding"], ids, cfg, None, True)
+        stacked = stack_layer_params(params["layers"])
+
+        def layer_fn(lp, h, r, m):
+            return encoder_layer_apply(lp, h, m, cfg, r, True)[0]
+
+        out = jax.jit(
+            lambda s, x, m: pipeline_apply(
+                s, layer_fn, x, (m,), mesh=mesh, num_microbatches=4
+            )
+        )(stacked, x, mask)
+        ref = x
+        for layer in params["layers"]:
+            ref, _, _ = encoder_layer_apply(layer, ref, mask, cfg, None, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
     def test_grads_match_sequential(self):
         mesh = _mesh(1, 4)
         params, x, mask = self._stack_io()

@@ -91,9 +91,10 @@ def define_flags() -> None:
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
+    from transformer_tpu.ops.ffn import FFN_ACTIVATIONS
+
     flags.DEFINE_enum(
-        "ffn_activation", "relu",
-        ["relu", "gelu", "silu", "swiglu", "geglu", "reglu"],
+        "ffn_activation", "relu", list(FFN_ACTIVATIONS),
         "FFN activation (reference: relu); swiglu/geglu/reglu are the gated "
         "three-matmul variants")
     flags.DEFINE_enum(

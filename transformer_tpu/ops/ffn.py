@@ -32,6 +32,9 @@ _GATED_ACTIVATIONS = {
     "reglu": jax.nn.relu,
 }
 
+# Public name list (CLI enum + config validation derive from this).
+FFN_ACTIVATIONS = tuple(sorted({**_ACTIVATIONS, **_GATED_ACTIVATIONS}))
+
 
 def is_gated(activation: str) -> bool:
     return activation in _GATED_ACTIVATIONS
@@ -44,13 +47,17 @@ def ffn_init(
     param_dtype=jnp.float32,
     activation: str = "relu",
 ) -> Params:
-    k1, k2, k3 = jax.random.split(key, 3)
+    # Ungated configs split exactly as before the gated variants existed, so
+    # seeded inits stay byte-identical regardless of JAX's split semantics.
+    k1, k2 = jax.random.split(key)
     params = {
         "in": dense_init(k1, d_model, dff, param_dtype),
         "out": dense_init(k2, dff, d_model, param_dtype),
     }
     if is_gated(activation):
-        params["gate"] = dense_init(k3, d_model, dff, param_dtype)
+        params["gate"] = dense_init(
+            jax.random.fold_in(key, 2), d_model, dff, param_dtype
+        )
     return params
 
 
