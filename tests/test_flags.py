@@ -59,6 +59,14 @@ def test_preset_long4k_is_decoder_only_flash():
     assert vals[8] == "4096" and vals[9] == "4"
 
 
+def test_ffn_activation_flag_list_matches_registry():
+    """flags.py keeps a jax-import-free literal; pin it to the op registry."""
+    from transformer_tpu.cli.flags import _FFN_ACTIVATION_NAMES
+    from transformer_tpu.ops.ffn import FFN_ACTIVATIONS
+
+    assert tuple(_FFN_ACTIVATION_NAMES) == FFN_ACTIVATIONS
+
+
 def test_presets_match_benchmark_configs():
     """--preset promises the BASELINE benchmark shapes; pin _PRESETS against
     benchmarks/run.py's _configs so the two tables cannot drift."""

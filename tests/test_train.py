@@ -91,6 +91,38 @@ class TestLoss:
         assert float(smooth) > float(sharp)
 
 
+class TestCheckpointAveraging:
+    def test_average_is_elementwise_mean(self, tmp_path):
+        """The classic Transformer eval trick: export the mean of the last N
+        rotated checkpoints."""
+        from transformer_tpu.train.checkpoint import average_checkpoints
+
+        base = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=5, is_primary=True)
+        import dataclasses as dc
+
+        scales = [1.0, 2.0, 6.0]
+        for i, s in enumerate(scales):
+            scaled = dc.replace(
+                base, params=jax.tree.map(lambda x: x * s, base.params)
+            )
+            mgr.save(scaled, step=i)
+        avg = average_checkpoints(mgr, base, mgr.all_steps())  # params tree
+        want = float(np.mean(scales))
+        for a, b in zip(jax.tree.leaves(avg), jax.tree.leaves(base.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b) * want, atol=1e-5
+            )
+
+    def test_rejects_empty(self, tmp_path):
+        from transformer_tpu.train.checkpoint import average_checkpoints
+
+        mgr = CheckpointManager(str(tmp_path), is_primary=True)
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        with pytest.raises(ValueError, match="at least one"):
+            average_checkpoints(mgr, state, [])
+
+
 class TestAdafactor:
     def test_overfit_one_batch(self):
         import dataclasses

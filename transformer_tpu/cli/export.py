@@ -24,6 +24,10 @@ def define_export_flags() -> None:
     define_flags()
     flags.DEFINE_string("export_path", "model", "output directory")
     flags.DEFINE_integer("step", 0, "checkpoint step to export (0 = latest)")
+    flags.DEFINE_integer(
+        "average_last", 1,
+        "average the params of the last N rotated checkpoints before export "
+        "(the classic Transformer BLEU trick; 1 = just the chosen step)")
 
 
 def main(argv) -> None:
@@ -60,6 +64,24 @@ def main(argv) -> None:
     step = FLAGS.step or mgr.latest_step
     if step is None:
         raise app.UsageError(f"no checkpoints under {FLAGS.ckpt_path!r}")
+    if FLAGS.step and FLAGS.step not in mgr.all_steps():
+        # Fail loudly for both the single-step and averaged paths (the
+        # averaged filter would otherwise silently tolerate a typo'd step).
+        raise app.UsageError(
+            f"no checkpoint at step {FLAGS.step} under {FLAGS.ckpt_path!r} "
+            f"(available: {mgr.all_steps()})"
+        )
+    if FLAGS.average_last > 1:
+        from transformer_tpu.train.checkpoint import average_checkpoints
+
+        steps = [s for s in mgr.all_steps() if s <= step][-FLAGS.average_last:]
+        avg_params = average_checkpoints(mgr, template, steps)
+        export_params(avg_params, model_cfg, FLAGS.export_path)
+        logging.info(
+            "exported average of steps %s from %s to %s",
+            steps, FLAGS.ckpt_path, FLAGS.export_path,
+        )
+        return
     state = mgr.restore(template, step)
     export_params(state.params, model_cfg, FLAGS.export_path)
     logging.info(

@@ -19,6 +19,11 @@ from transformer_tpu.config import MeshConfig, ModelConfig, TrainConfig
 FLAGS = flags.FLAGS
 
 
+# Literal so flag definition stays jax-import-free (the CLIs defer `import
+# jax` into main() on purpose — env/platform setup must run first);
+# tests/test_flags.py pins this against ops.ffn.FFN_ACTIVATIONS.
+_FFN_ACTIVATION_NAMES = ("geglu", "gelu", "reglu", "relu", "silu", "swiglu")
+
 # One-flag reproduction of the BASELINE.json benchmark configs: values land
 # on flags the user did NOT set explicitly (explicit flags always win).
 _PRESETS: dict[str, dict] = {
@@ -91,10 +96,8 @@ def define_flags() -> None:
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
-    from transformer_tpu.ops.ffn import FFN_ACTIVATIONS
-
     flags.DEFINE_enum(
-        "ffn_activation", "relu", list(FFN_ACTIVATIONS),
+        "ffn_activation", "relu", list(_FFN_ACTIVATION_NAMES),
         "FFN activation (reference: relu); swiglu/geglu/reglu are the gated "
         "three-matmul variants")
     flags.DEFINE_enum(

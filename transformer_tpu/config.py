@@ -107,16 +107,15 @@ class ModelConfig:
                 "position_scheme='rope' needs an even head_dim "
                 f"(got {self.d_model // self.num_heads})"
             )
-        # Single source of truth for activation names: the op registries.
-        from transformer_tpu.ops.ffn import _ACTIVATIONS, _GATED_ACTIVATIONS
+        # Single source of truth for activation names: the op registry.
+        from transformer_tpu.ops.ffn import FFN_ACTIVATIONS, is_gated
 
-        if self.ffn_activation not in {**_ACTIVATIONS, **_GATED_ACTIVATIONS}:
+        if self.ffn_activation not in FFN_ACTIVATIONS:
             raise ValueError(f"unknown ffn_activation {self.ffn_activation!r}")
-        if self.moe_experts and self.ffn_activation not in _ACTIVATIONS:
+        if self.moe_experts and is_gated(self.ffn_activation):
             raise ValueError(
-                "MoE experts use the ungated FFN: pick one of "
-                f"{sorted(_ACTIVATIONS)} with moe_experts > 0 "
-                f"(got {self.ffn_activation!r})"
+                "MoE experts use the ungated FFN: pick an ungated activation "
+                f"with moe_experts > 0 (got {self.ffn_activation!r})"
             )
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")

@@ -32,7 +32,8 @@ _GATED_ACTIVATIONS = {
     "reglu": jax.nn.relu,
 }
 
-# Public name list (CLI enum + config validation derive from this).
+# Public name list: config validation derives from this; the CLI keeps a
+# jax-import-free literal copy pinned to it by tests/test_flags.py.
 FFN_ACTIVATIONS = tuple(sorted({**_ACTIVATIONS, **_GATED_ACTIVATIONS}))
 
 

@@ -496,6 +496,37 @@ class AsyncCheckpointManager(CheckpointManager):
         return super().restore_latest(target)
 
 
+def average_checkpoints(
+    mgr: CheckpointManager, template: Any, steps: list[int]
+) -> Any:
+    """Uniform PARAMETER average over the given checkpoint steps — the
+    classic Transformer eval trick (Vaswani et al. averaged the last
+    checkpoints before scoring BLEU; the reference keeps rotated
+    checkpoints, ``max_to_keep``, but never averages them). Restores each
+    step into ``template``'s structure (a TrainState) and returns only the
+    averaged ``params`` subtree: fp64 accumulation, cast back to each
+    leaf's dtype. Optimizer state is restored transiently (the checkpoint
+    format stores the whole state) but never accumulated — averaged Adam
+    moments would be meaningless and would double the accumulator."""
+    if not steps:
+        raise ValueError("average_checkpoints needs at least one step")
+    acc = None
+    for step in steps:
+        params = mgr.restore(template, step).params
+        if acc is None:
+            acc = jax.tree.map(lambda x: np.asarray(x, np.float64), params)
+        else:
+            acc = jax.tree.map(
+                lambda a, x: a + np.asarray(x, np.float64), acc, params
+            )
+    n = float(len(steps))
+    return jax.tree.map(
+        lambda a, t: (a / n).astype(np.asarray(t).dtype),
+        acc,
+        jax.tree.map(np.asarray, template.params),
+    )
+
+
 def export_params(params: Any, model_cfg, path: str) -> None:
     """Model export for serving — the counterpart of the reference's final
     ``tf.saved_model.save`` (``train.py:246``, README "Model Exporting"):
