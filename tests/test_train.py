@@ -51,6 +51,52 @@ class TestSchedule:
     def test_warmup_default_matches_reference(self):
         assert TrainConfig().warmup_steps == 60000
 
+    def test_cosine_curve(self):
+        from transformer_tpu.train.schedule import cosine_schedule
+
+        sched = cosine_schedule(1e-3, warmup_steps=100, decay_steps=1000)
+        # Linear warmup hits the peak at the boundary.
+        np.testing.assert_allclose(float(sched(99)), 1e-3, rtol=1e-5)
+        np.testing.assert_allclose(float(sched(49)), 5e-4, rtol=2e-2)
+        # Midpoint of the cosine: halfway between peak and floor.
+        np.testing.assert_allclose(float(sched(550)), (1e-3 + 1e-4) / 2, rtol=1e-4)
+        # Floor (peak/10) at and beyond the horizon.
+        np.testing.assert_allclose(float(sched(1000)), 1e-4, rtol=1e-5)
+        np.testing.assert_allclose(float(sched(5000)), 1e-4, rtol=1e-5)
+
+    def test_constant_curve(self):
+        from transformer_tpu.train.schedule import constant_schedule
+
+        sched = constant_schedule(3e-4, warmup_steps=10)
+        np.testing.assert_allclose(float(sched(4)), 1.5e-4, rtol=1e-5)
+        np.testing.assert_allclose(float(sched(10)), 3e-4, rtol=1e-6)
+        np.testing.assert_allclose(float(sched(9999)), 3e-4, rtol=1e-6)
+
+    def test_cosine_trains_through_config(self):
+        import dataclasses
+
+        tc = dataclasses.replace(
+            TCFG, lr_schedule="cosine", peak_lr=1e-3,
+            warmup_steps=20, lr_decay_steps=200,
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        step = jax.jit(make_train_step(TINY, tc))
+        r = np.random.default_rng(0)
+        src = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        first = None
+        for _ in range(60):
+            state, m = step(state, src, tgt, rng)
+            first = float(m["loss"]) if first is None else first
+        assert float(m["loss"]) < first * 0.6
+
+    def test_cosine_requires_peak_and_horizon(self):
+        with pytest.raises(ValueError, match="peak_lr"):
+            TrainConfig(lr_schedule="cosine", lr_decay_steps=10**6)
+        with pytest.raises(ValueError, match="lr_decay_steps"):
+            TrainConfig(lr_schedule="cosine", peak_lr=1e-3)
+
 
 class TestLoss:
     def test_pad_positions_contribute_zero(self):

@@ -71,10 +71,20 @@ def main(argv) -> None:
             f"no checkpoint at step {FLAGS.step} under {FLAGS.ckpt_path!r} "
             f"(available: {mgr.all_steps()})"
         )
+    if FLAGS.average_last < 1:
+        raise app.UsageError(
+            f"--average_last must be >= 1, got {FLAGS.average_last}"
+        )
     if FLAGS.average_last > 1:
         from transformer_tpu.train.checkpoint import average_checkpoints
 
         steps = [s for s in mgr.all_steps() if s <= step][-FLAGS.average_last:]
+        if len(steps) < FLAGS.average_last:
+            logging.warning(
+                "only %d checkpoint(s) retained (<= step %d); averaging "
+                "those instead of the requested %d",
+                len(steps), step, FLAGS.average_last,
+            )
         avg_params = average_checkpoints(mgr, template, steps)
         export_params(avg_params, model_cfg, FLAGS.export_path)
         logging.info(

@@ -84,7 +84,18 @@ def define_flags() -> None:
     flags.DEFINE_float("dropout_rate", 0.1, "dropout rate")
     # --- framework extensions ---
     flags.DEFINE_integer("target_vocab_size", 2**15, "subword vocab build target")
-    flags.DEFINE_integer("warmup_steps", 60000, "noam warmup steps")
+    flags.DEFINE_integer(
+        "warmup_steps", 60000,
+        "LR warmup steps, shared by every --lr_schedule; the 60000 default "
+        "is reference-noam parity — set a small value (hundreds) for "
+        "cosine/constant runs")
+    flags.DEFINE_enum(
+        "lr_schedule", "noam", ["noam", "cosine", "constant"],
+        "LR schedule: noam (reference), or warmup + cosine-decay / constant "
+        "at --peak_lr (modern-LM schedules)")
+    flags.DEFINE_float("peak_lr", 0.0, "peak LR for cosine/constant schedules")
+    flags.DEFINE_integer(
+        "lr_decay_steps", 0, "cosine horizon (decays to peak_lr/10 here)")
     flags.DEFINE_float("label_smoothing", 0.0, "label smoothing epsilon")
     flags.DEFINE_enum("loss_normalization", "tokens", ["tokens", "batch"],
                       "CE normalization ('batch' = reference rule)")
@@ -219,6 +230,9 @@ def flags_to_train_config() -> TrainConfig:
         sequence_length=FLAGS.sequence_length,
         epochs=FLAGS.epochs,
         warmup_steps=FLAGS.warmup_steps,
+        lr_schedule=FLAGS.lr_schedule,
+        peak_lr=FLAGS.peak_lr,
+        lr_decay_steps=FLAGS.lr_decay_steps,
         label_smoothing=FLAGS.label_smoothing,
         loss_normalization=FLAGS.loss_normalization,
         max_grad_norm=FLAGS.max_grad_norm,

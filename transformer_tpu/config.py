@@ -165,6 +165,13 @@ class TrainConfig:
     # Noam schedule warmup. The reference defaults to 60000 (``train.py:22``),
     # not the paper's 4000 — kept as the default for parity.
     warmup_steps: int = 60000
+    # LR schedule family (train/schedule.py): "noam" is the reference's
+    # CustomSchedule; "cosine"/"constant" warm up linearly to ``peak_lr``
+    # (required > 0 for those), cosine decaying to peak_lr/10 at
+    # ``lr_decay_steps`` (required for cosine).
+    lr_schedule: str = "noam"  # "noam" | "cosine" | "constant"
+    peak_lr: float = 0.0
+    lr_decay_steps: int = 0
     adam_beta1: float = 0.9
     adam_beta2: float = 0.98
     adam_epsilon: float = 1e-9
@@ -213,6 +220,19 @@ class TrainConfig:
         if self.optimizer not in ("adam", "adafactor"):
             raise ValueError(
                 f"optimizer must be 'adam' or 'adafactor', got {self.optimizer!r}"
+            )
+        if self.lr_schedule not in ("noam", "cosine", "constant"):
+            raise ValueError(
+                f"lr_schedule must be noam/cosine/constant, got {self.lr_schedule!r}"
+            )
+        if self.lr_schedule != "noam" and self.peak_lr <= 0:
+            raise ValueError(
+                f"lr_schedule={self.lr_schedule!r} needs peak_lr > 0"
+            )
+        if self.lr_schedule == "cosine" and self.lr_decay_steps <= self.warmup_steps:
+            raise ValueError(
+                "lr_schedule='cosine' needs lr_decay_steps > warmup_steps "
+                f"(got {self.lr_decay_steps} <= {self.warmup_steps})"
             )
 
 

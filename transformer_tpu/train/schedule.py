@@ -4,6 +4,11 @@
 ``d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)`` — linear warmup to
 ``warmup_steps`` then inverse-sqrt decay. The reference's default warmup is
 60000 (``train.py:22``), not the Vaswani paper's 4000.
+
+``cosine_schedule`` / ``constant_schedule`` are extensions (no reference
+counterpart): linear warmup to an explicit peak, then cosine decay to a
+floor / flat — the standard modern-LM schedules for the decoder-only
+family, where noam's d_model coupling is an odd fit.
 """
 
 from __future__ import annotations
@@ -21,5 +26,43 @@ def noam_schedule(d_model: int, warmup_steps: int = 60000):
     def schedule(step):
         s = jnp.asarray(step, dtype=jnp.float32) + 1.0
         return scale * jnp.minimum(s**-0.5, s * warmup)
+
+    return schedule
+
+
+def cosine_schedule(
+    peak_lr: float,
+    warmup_steps: int,
+    decay_steps: int,
+    floor_ratio: float = 0.1,
+):
+    """Linear warmup to ``peak_lr`` over ``warmup_steps``, then a half cosine
+    down to ``peak_lr * floor_ratio`` at ``decay_steps`` (flat floor after)."""
+    if decay_steps <= warmup_steps:
+        raise ValueError(
+            f"decay_steps ({decay_steps}) must exceed warmup_steps "
+            f"({warmup_steps})"
+        )
+    floor = peak_lr * floor_ratio
+
+    def schedule(step):
+        s = jnp.asarray(step, dtype=jnp.float32)
+        warm = peak_lr * (s + 1.0) / max(warmup_steps, 1)
+        frac = jnp.clip(
+            (s - warmup_steps) / (decay_steps - warmup_steps), 0.0, 1.0
+        )
+        cos = floor + (peak_lr - floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(s < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def constant_schedule(peak_lr: float, warmup_steps: int):
+    """Linear warmup to ``peak_lr``, then flat."""
+
+    def schedule(step):
+        s = jnp.asarray(step, dtype=jnp.float32)
+        warm = peak_lr * (s + 1.0) / max(warmup_steps, 1)
+        return jnp.where(s < warmup_steps, warm, peak_lr)
 
     return schedule

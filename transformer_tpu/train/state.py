@@ -32,6 +32,16 @@ def make_lr_schedule(model_cfg: ModelConfig, train_cfg: TrainConfig):
     """THE learning-rate schedule — single definition shared by the optimizer
     and observability (TensorBoard's learning_rate scalar), so the plotted
     curve can never drift from the one actually applied."""
+    if train_cfg.lr_schedule == "cosine":
+        from transformer_tpu.train.schedule import cosine_schedule
+
+        return cosine_schedule(
+            train_cfg.peak_lr, train_cfg.warmup_steps, train_cfg.lr_decay_steps
+        )
+    if train_cfg.lr_schedule == "constant":
+        from transformer_tpu.train.schedule import constant_schedule
+
+        return constant_schedule(train_cfg.peak_lr, train_cfg.warmup_steps)
     return noam_schedule(model_cfg.d_model, train_cfg.warmup_steps)
 
 
