@@ -211,6 +211,46 @@ def test_read_lines_strips_newlines(tmp_path):
     assert read_lines(str(p)) == ["a b", "c d"]
 
 
+class TestPerplexity:
+    def test_overfit_lm_scores_low(self):
+        """An LM overfit on the sentences must assign them far lower
+        perplexity than a random-init model; non-decoder models rejected."""
+        import dataclasses
+
+        from transformer_tpu.models import transformer_init
+        from transformer_tpu.train import create_train_state, make_train_step
+        from transformer_tpu.train.evaluate import perplexity_on_lines
+
+        tok = SubwordTokenizer.build_from_corpus(SENTENCES, target_vocab_size=400)
+        cfg = ModelConfig(
+            num_layers=1, d_model=32, num_heads=2, dff=64,
+            input_vocab_size=tok.model_vocab_size,
+            target_vocab_size=tok.model_vocab_size,
+            max_position=32, dtype="float32", dropout_rate=0.0,
+            decoder_only=True,
+        )
+        tcfg = TrainConfig(batch_size=8, sequence_length=16, warmup_steps=40)
+        width = 16
+        ids = np.zeros((8, width), np.int32)
+        for i, s in enumerate(SENTENCES):
+            e = [tok.bos_id, *tok.encode(s), tok.eos_id]
+            ids[i, : len(e)] = e[:width]
+        state = create_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+        step = jax.jit(make_train_step(cfg, tcfg))
+        rng = jax.random.PRNGKey(1)
+        for _ in range(200):
+            state, _ = step(state, None, ids, rng)
+        ppl_trained, n = perplexity_on_lines(state.params, cfg, tok, SENTENCES)
+        assert n > 0
+        random_params = transformer_init(jax.random.PRNGKey(9), cfg)
+        ppl_random, _ = perplexity_on_lines(random_params, cfg, tok, SENTENCES)
+        assert ppl_trained < 3.0 < ppl_random
+
+        s2s = dataclasses.replace(cfg, decoder_only=False)
+        with pytest.raises(ValueError, match="decoder_only"):
+            perplexity_on_lines(state.params, s2s, tok, SENTENCES)
+
+
 def test_dump_attention_maps(tmp_path, overfit_setup):
     """The interpretability artifact: per-layer maps for (src, tgt) pairs,
     trimmed to true lengths, rows summing to 1 (softmax)."""

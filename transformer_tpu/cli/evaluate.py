@@ -1,11 +1,14 @@
-"""Score a trained model: corpus BLEU on a parallel src/tgt file pair.
+"""Score a trained model: corpus BLEU (seq2seq) or perplexity (LM).
 
     python -m transformer_tpu.cli.evaluate --export_path=model \
         --src_file=data/src-test.txt --tgt_file=data/tgt-test.txt \
         --src_vocab_file=src_vocab.subwords --tgt_vocab_file=tgt_vocab.subwords
 
-Prints one JSON line ``{"bleu": ..., "n": ...}`` (stdout) so benchmark
-harnesses can parse it; progress goes to logging/stderr.
+Prints one JSON line on stdout so benchmark harnesses can parse it —
+``{"bleu": ..., "n": ..., "beam": ...}`` for seq2seq exports, or
+``{"perplexity": ..., "n_tokens": ...}`` when the export is a
+``decoder_only`` LM (scored on ``--tgt_file``; the src flags are unused).
+Progress goes to logging/stderr.
 """
 
 from __future__ import annotations
@@ -39,9 +42,27 @@ def main(argv) -> None:
 
     from transformer_tpu.cli.translate import load_export
     from transformer_tpu.data.tokenizer import SubwordTokenizer
-    from transformer_tpu.train.evaluate import bleu_on_pairs, read_lines
+    from transformer_tpu.train.evaluate import (
+        bleu_on_pairs,
+        perplexity_on_lines,
+        read_lines,
+    )
 
     params, model_cfg = load_export(FLAGS.export_path)
+    if model_cfg.decoder_only:
+        # LM family: no translation to score — report token perplexity on
+        # the target-side text instead.
+        tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+        lines = read_lines(FLAGS.tgt_file)
+        if FLAGS.limit:
+            lines = lines[: FLAGS.limit]
+        ppl, n_tokens = perplexity_on_lines(
+            params, model_cfg, tok, lines,
+            batch_size=FLAGS.batch_size, log_fn=logging.info,
+        )
+        logging.info("perplexity %.2f over %d tokens", ppl, n_tokens)
+        print(json.dumps({"perplexity": round(ppl, 3), "n_tokens": n_tokens}))
+        return
     src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
     tgt_tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
     src_lines = read_lines(FLAGS.src_file)

@@ -62,6 +62,63 @@ def read_lines(path: str) -> list[str]:
         return [line.rstrip("\n") for line in f]
 
 
+def perplexity_on_lines(
+    params,
+    model_cfg: ModelConfig,
+    tok,
+    lines: list[str],
+    *,
+    batch_size: int = 64,
+    log_fn: Callable[[str], None] | None = None,
+) -> tuple[float, int]:
+    """Token-level perplexity of a ``decoder_only`` LM over text lines —
+    the LM-family counterpart of BLEU for seq2seq (the reference has
+    neither; it reports token accuracy only, ``train.py:140-141``).
+
+    Each line becomes a BOS-led, EOS-terminated window (the LM training
+    convention, ``data.pipeline.make_lm_dataset``), clipped to
+    ``max_position``; rows pad to power-of-two width buckets so scoring
+    compiles once per (batch, width). Returns (perplexity, token_count):
+    exp of the corpus mean CE over non-pad target positions.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from transformer_tpu.models import transformer_apply
+    from transformer_tpu.train.decode import _bucket, _pad_batch
+    from transformer_tpu.train.loss import masked_cross_entropy
+
+    if not model_cfg.decoder_only:
+        raise ValueError("perplexity_on_lines is for decoder_only models")
+    if not lines:
+        # exp(0/1) would "score" an empty file as a perfect 1.0.
+        raise ValueError("perplexity_on_lines got no input lines")
+
+    @jax.jit
+    def sums(params, ids):
+        tar_inp, tar_out = ids[:, :-1], ids[:, 1:]
+        logits, _ = transformer_apply(params, None, tar_inp, model_cfg)
+        _, m = masked_cross_entropy(logits, tar_out)
+        return m["loss_sum"], m["weight"]
+
+    cap = model_cfg.max_position
+    encoded = [[tok.bos_id, *tok.encode(l), tok.eos_id][: cap + 1] for l in lines]
+    total_ls = total_w = 0.0
+    for start in range(0, len(encoded), batch_size):
+        chunk = encoded[start : start + batch_size]
+        width = _bucket(max(len(e) for e in chunk), cap + 1, floor=8)
+        ids, _ = _pad_batch(chunk, width)
+        ls, w = sums(params, jnp.asarray(ids))
+        total_ls += float(ls)
+        total_w += float(w)
+        if log_fn is not None and start // batch_size % 4 == 0:
+            log_fn(f"perplexity eval: {start + len(chunk)}/{len(encoded)} scored")
+    import math
+
+    ppl = math.exp(total_ls / max(total_w, 1.0))
+    return ppl, int(total_w)
+
+
 def dump_attention_maps(
     params,
     model_cfg: ModelConfig,
