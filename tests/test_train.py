@@ -137,6 +137,115 @@ class TestLoss:
         assert float(smooth) > float(sharp)
 
 
+class _FixedBatches:
+    """Minimal dataset stub: the same batch ``n`` times per epoch."""
+
+    def __init__(self, n=4, seed=0):
+        self.n = n
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        self.src = np.asarray(jax.random.randint(k1, (4, 8), 1, 30))
+        self.tgt = np.asarray(jax.random.randint(k2, (4, 8), 1, 30))
+
+    def __len__(self):
+        return self.n
+
+    def batches(self, epoch=0):
+        for _ in range(self.n):
+            yield self.src, self.tgt
+
+
+class TestEarlyStopping:
+    def test_stops_when_eval_plateaus(self):
+        """Overfitting a fixed batch while evaluating on a DIFFERENT fixed
+        batch: eval loss rises/plateaus once the model memorizes, so
+        patience=2 must end the run well before the epoch budget."""
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        tc = dataclasses.replace(
+            TCFG, epochs=40, warmup_steps=10, early_stop_patience=2,
+            eval_every_steps=0, log_every_steps=0,
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        logs = []
+        tr = Trainer(TINY, tc, state, log_fn=logs.append)
+        tr.fit(_FixedBatches(n=8, seed=0), _FixedBatches(n=2, seed=7))
+        done = [l for l in logs if "done in" in l]
+        assert any("early stop" in l for l in logs), logs[-3:]
+        assert len(done) < 40  # stopped before the epoch budget
+
+    def test_marker_blocks_relaunch(self, tmp_path):
+        """A relaunch after an early stop must not retrain past the stopped
+        checkpoint (job-scheduler retries would otherwise overwrite it)."""
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        tc = dataclasses.replace(
+            TCFG, epochs=40, warmup_steps=10, early_stop_patience=2,
+            eval_every_steps=0, log_every_steps=0, checkpoint_every_epochs=1,
+        )
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2, is_primary=True)
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        logs = []
+        tr = Trainer(TINY, tc, state, checkpoint=mgr, log_fn=logs.append)
+        tr.fit(_FixedBatches(n=8, seed=0), _FixedBatches(n=2, seed=7))
+        assert any("early stop" in l for l in logs)
+        assert (tmp_path / "EARLY_STOPPED").exists()
+        saved_steps = mgr.all_steps()
+
+        relaunch_logs = []
+        state2 = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        mgr2 = CheckpointManager(str(tmp_path), max_to_keep=2, is_primary=True)
+        tr2 = Trainer(TINY, tc, state2, checkpoint=mgr2, log_fn=relaunch_logs.append)
+        tr2.fit(_FixedBatches(n=8, seed=0), _FixedBatches(n=2, seed=7))
+        assert any("marker present" in l for l in relaunch_logs)
+        assert not any("done in" in l for l in relaunch_logs)  # no training
+        assert mgr2.all_steps() == saved_steps  # checkpoints untouched
+
+    def test_empty_eval_gives_no_signal(self):
+        """A zero-weight eval (empty test split) must not lock best_eval at
+        0.0 and fire a spurious stop."""
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        class _Empty:
+            def __len__(self):
+                return 0
+
+            def batches(self, epoch=0):
+                return iter(())
+
+        tc = dataclasses.replace(
+            TCFG, epochs=4, warmup_steps=10, early_stop_patience=1,
+            eval_every_steps=0, log_every_steps=0,
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        logs = []
+        tr = Trainer(TINY, tc, state, log_fn=logs.append)
+        tr.fit(_FixedBatches(n=2, seed=0), _Empty())
+        assert len([l for l in logs if "done in" in l]) == 4
+        assert not any("early stop" in l for l in logs)
+
+    def test_disabled_runs_all_epochs(self):
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        tc = dataclasses.replace(
+            TCFG, epochs=4, warmup_steps=10, early_stop_patience=0,
+            eval_every_steps=0, log_every_steps=0,
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        logs = []
+        tr = Trainer(TINY, tc, state, log_fn=logs.append)
+        tr.fit(_FixedBatches(n=2, seed=0), _FixedBatches(n=1, seed=7))
+        assert len([l for l in logs if "done in" in l]) == 4
+        assert not any("early stop" in l for l in logs)
+
+
 class TestCheckpointAveraging:
     def test_average_is_elementwise_mean(self, tmp_path):
         """The classic Transformer eval trick: export the mean of the last N

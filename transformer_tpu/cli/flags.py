@@ -175,6 +175,10 @@ def define_flags() -> None:
         "eval_max_batches", 8,
         "cap on in-loop eval batches (0 = full test set each eval)")
     flags.DEFINE_integer(
+        "early_stop_patience", 0,
+        "stop after this many consecutive epochs without eval-loss "
+        "improvement (0 = run all epochs, the reference behavior)")
+    flags.DEFINE_integer(
         "grad_accum", 1,
         "gradient-accumulation micro-steps per optimizer update (1 = off)")
     flags.DEFINE_integer(
@@ -244,6 +248,7 @@ def flags_to_train_config() -> TrainConfig:
         seed=FLAGS.seed,
         pp_microbatches=FLAGS.pp_microbatches,
         eval_max_batches=FLAGS.eval_max_batches,
+        early_stop_patience=FLAGS.early_stop_patience,
         grad_accum_steps=FLAGS.grad_accum,
         loss_chunks=FLAGS.loss_chunks,
     )

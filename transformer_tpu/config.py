@@ -193,6 +193,10 @@ class TrainConfig:
     # train.py:94``) — both defects (SURVEY §2.3.3/.6). Bounded and
     # configurable here; 0 = no cap (full test set).
     eval_max_batches: int = 8
+    # Early stopping: stop after this many consecutive epochs without
+    # end-of-epoch eval-loss improvement (0 = off; needs a test dataset).
+    # The reference always runs all epochs (``train.py:180``).
+    early_stop_patience: int = 0
     log_every_steps: int = 100
     checkpoint_every_epochs: int = 5  # intent of the reference's (buggy) save cond
     max_ckpt_keep: int = 5

@@ -471,6 +471,20 @@ class Trainer:
                     f"resuming at epoch {start_epoch + 1}/{cfg.epochs} "
                     f"(step {step})"
                 )
+        if cfg.early_stop_patience and self._early_stop_marker_exists():
+            # A previous run of this checkpoint directory already stopped on
+            # an eval-loss plateau; a relaunch (job-scheduler retry) must not
+            # train past it and overwrite the early-stopped checkpoint.
+            self.log_fn(
+                "early-stop marker present in checkpoint dir; not training "
+                "further (delete the EARLY_STOPPED file to continue)"
+            )
+            return
+        # NOTE: plateau accounting is host-local and not checkpointed — a
+        # preempted-and-resumed run starts its patience window fresh and may
+        # train up to `patience` extra epochs past the original plateau.
+        best_eval = float("inf")
+        epochs_since_best = 0
         with PreemptionGuard() as guard:
             for epoch in range(start_epoch, cfg.epochs):
                 self.train_metrics.reset()
@@ -537,17 +551,59 @@ class Trainer:
                 )
                 if epoch_callback is not None:
                     epoch_callback(epoch, self)
+                stop_early = False
+                if (
+                    cfg.early_stop_patience
+                    and test_ds is not None
+                    and self.eval_metrics.weight > 0  # empty eval: no signal
+                ):
+                    # The full end-of-epoch eval above populated eval_metrics.
+                    if self.eval_metrics.loss < best_eval - 1e-6:
+                        best_eval = self.eval_metrics.loss
+                        epochs_since_best = 0
+                    else:
+                        epochs_since_best += 1
+                        stop_early = epochs_since_best >= cfg.early_stop_patience
                 if self.checkpoint is not None and (
                     (epoch + 1) % cfg.checkpoint_every_epochs == 0
                     or (epoch + 1) == cfg.epochs
+                    or stop_early
                 ):
                     self.checkpoint.save(self.state)
+                if stop_early:
+                    self.log_fn(
+                        f"early stop after epoch {epoch + 1}: eval loss has "
+                        f"not improved for {epochs_since_best} epoch(s) "
+                        f"(best {best_eval:.4f})"
+                    )
+                    self._mark_early_stopped(epoch + 1)
+                    break
         if self.checkpoint is not None:
             # Async managers write in the background; don't return (or let the
             # process exit) with the final checkpoint still uncommitted.
             self.checkpoint.wait()
         if self.profiler is not None:
             self.profiler.stop(block_on=self.state)
+
+    def _early_stop_marker_path(self) -> str | None:
+        if self.checkpoint is None:
+            return None
+        import os
+
+        return os.path.join(self.checkpoint.directory, "EARLY_STOPPED")
+
+    def _early_stop_marker_exists(self) -> bool:
+        import os
+
+        path = self._early_stop_marker_path()
+        return path is not None and os.path.exists(path)
+
+    def _mark_early_stopped(self, epoch: int) -> None:
+        path = self._early_stop_marker_path()
+        if path is None or not getattr(self.checkpoint, "is_primary", True):
+            return
+        with open(path, "w") as f:
+            f.write(f"early stop after epoch {epoch}\n")
 
     def _preempt(self, step: int, guard: "PreemptionGuard") -> None:
         """Graceful shutdown on SIGTERM/SIGINT: checkpoint, flush, report."""
