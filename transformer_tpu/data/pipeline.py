@@ -418,6 +418,7 @@ def load_dataset(
     require_test: bool = False,
     prefetch: bool = False,
     length_buckets: tuple[int, ...] = (),
+    exclude_test_overlap: bool = False,
 ) -> tuple[Seq2SeqDataset, Seq2SeqDataset | None, SubwordTokenizer, SubwordTokenizer]:
     """Build train (+ optional test) datasets plus both tokenizers —
     the counterpart of reference ``load_dataset`` (``utils.py:114-161``).
@@ -427,10 +428,32 @@ def load_dataset(
     (``utils.py:145-147,153``). The reference also *loads* test files that it
     doesn't ship (``utils.py:132-133``, quirk §2.3.10) — here the test split is
     optional and simply skipped when absent unless ``require_test``.
+
+    ``exclude_test_overlap`` drops every train pair whose exact (src, tgt)
+    line pair also appears in the test split. The bundled test split is drawn
+    from the train corpus tail (data/README.md), so without this the BLEU
+    north star would be scored in-sample; with it, held-out. Tokenizer vocabs
+    are still built from the FULL train files, so persisted ``*.subwords``
+    caches are identical with and without the holdout.
     """
     src_lines, tgt_lines = read_parallel_corpus(dataset_path, "train")
     src_tok = load_or_build_tokenizer(src_vocab_file, src_lines, target_vocab_size)
     tgt_tok = load_or_build_tokenizer(tgt_vocab_file, tgt_lines, target_vocab_size)
+
+    if exclude_test_overlap:
+        try:
+            held_src, held_tgt = read_parallel_corpus(dataset_path, "test")
+        except FileNotFoundError:
+            held_src, held_tgt = [], []
+        held = set(zip(held_src, held_tgt))
+        if held:
+            keep_pair = [
+                i
+                for i in range(len(src_lines))
+                if (src_lines[i], tgt_lines[i]) not in held
+            ]
+            src_lines = [src_lines[i] for i in keep_pair]
+            tgt_lines = [tgt_lines[i] for i in keep_pair]
 
     src_ids = _encode_and_frame(src_lines, src_tok)
     tgt_ids = _encode_and_frame(tgt_lines, tgt_tok)
