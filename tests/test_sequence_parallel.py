@@ -16,6 +16,10 @@ from transformer_tpu.ops.attention import dot_product_attention
 from transformer_tpu.parallel.mesh import make_mesh
 from transformer_tpu.parallel.ring_attention import make_sequence_parallel_attention
 
+# Heavyweight module (interpret-mode Pallas / 8-device shard_map /
+# multi-process): excluded from the fast path, pytest -m 'not slow'.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def seq_mesh():
@@ -230,6 +234,23 @@ class TestSeqParallelTraining:
         want = self._single_losses(ref_model, tcfg, batches)
         got = self._mesh_losses(
             model, tcfg, batches, MeshConfig(data=1, seq=8)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    def test_ring_with_chunked_loss_matches_monolithic(self):
+        """r2 VERDICT next-#5: loss_chunks composes with the sequence-
+        parallel forward — ring attention + chunked vocab-projection CE (the
+        long-context memory lever pair) must match the single-device
+        monolithic loss."""
+        import dataclasses
+
+        model, tcfg = self._configs("ring", decoder_only=True, seq_len=17)
+        ref_model, _ = self._configs("xla", decoder_only=True, seq_len=17)
+        tcfg_chunk = dataclasses.replace(tcfg, loss_chunks=4)
+        batches = self._batches(3, seq_len=17)
+        want = self._single_losses(ref_model, tcfg, batches)
+        got = self._mesh_losses(
+            model, tcfg_chunk, batches, MeshConfig(data=2, seq=4)
         )
         np.testing.assert_allclose(got, want, rtol=2e-4)
 

@@ -55,6 +55,25 @@ class _FlashConfig:
     num_heads: int  # for the kv-mask index map: grid axis 0 runs over B*H
     scale: float
     interpret: bool
+    # Grouped-query attention: k/v arrive folded as (B*H_kv, S_k, D) and each
+    # kv head serves num_heads/num_kv_heads query heads VIA THE BLOCKSPEC
+    # INDEX MAPS — kv is never materialized at the full head count, so HBM kv
+    # traffic stays at the H_kv rate (the whole point of GQA).
+    num_kv_heads: int = 0  # 0 = same as num_heads (plain MHA)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def group(self) -> int:
+        return self.num_heads // self.kv_heads
+
+    def kv_row(self, b):
+        """Grid row over B*H -> row of the folded (B*H_kv, S, D) kv array."""
+        if self.group == 1:
+            return b
+        return (b // self.num_heads) * self.kv_heads + (b % self.num_heads) // self.group
 
 
 def _largest_divisor_block(seq_len: int, requested: int) -> int:
@@ -195,8 +214,8 @@ def _fwd(cfg: _FlashConfig, q, k, v, kv_mask):
         inputs.append(kv_mask)
     in_specs += [
         pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (cfg.kv_row(b), j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (cfg.kv_row(b), j, 0)),
     ]
     inputs += [q, k, v]
 
@@ -223,6 +242,144 @@ def _fwd(cfg: _FlashConfig, q, k, v, kv_mask):
         interpret=cfg.interpret,
     )(*inputs)
     return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Ring-step forward: the same blockwise inner loop, but the online-softmax
+# carry (running max m, normalizer l, unnormalized accumulator acc) is an
+# HBM-resident input/output instead of kernel-local scratch, so sequence-
+# parallel ring attention (parallel/ring_attention.py) can fold one KV chunk
+# per ring hop without ever materializing a (C, C) score tensor.
+# ---------------------------------------------------------------------------
+
+
+def _ring_step_kernel(cfg: _FlashConfig, *refs):
+    if cfg.has_mask:
+        (mask_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+         m_out, l_out, acc_out, m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+         m_out, l_out, acc_out, m_scr, l_scr, acc_scr) = refs
+        mask_ref = None
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.broadcast_to(m_in[0, 0], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_in[0, 0], l_scr.shape)
+        acc_scr[:] = acc_in[0]
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * cfg.scale
+        )
+        s = _tile_bias(cfg, s, i, j, mask_ref)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(s > _MASK_GUARD, jnp.exp(s - m_new), 0.0)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = correction * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0]
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if cfg.causal:
+        pl.when(_visible(cfg, i, j))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _write():
+        m_out[0, 0] = m_scr[:, 0:1]
+        l_out[0, 0] = l_scr[:, 0:1]
+        acc_out[0] = acc_scr[:]
+
+
+def flash_ring_step(
+    cfg: _FlashConfig,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None,
+    m: jax.Array,
+    l: jax.Array,
+    acc: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold one KV chunk into the online-softmax carry.
+
+    Args (all folded to grid layout):
+      q:    (BH, S_q, D) local query chunk (model dtype).
+      k, v: (BH, C, D) the KV chunk visiting this ring step.
+      kv_mask: pre-tiled (B, C // block_k, 1, block_k) int32 or None
+        (must match ``cfg.has_mask``).
+      m, l: (BH, nq, block_q, 1) fp32 running max / normalizer.
+      acc:  (BH, S_q, D) fp32 unnormalized output accumulator.
+
+    Returns the updated ``(m, l, acc)``. ``cfg.causal`` here means "this is
+    the diagonal chunk pair" — intra-tile causality applies; fully-below-
+    diagonal pairs use a non-causal cfg and fully-above pairs are skipped by
+    the caller.
+    """
+    bh, s_q, d = q.shape
+    c = k.shape[1]
+    nq = s_q // cfg.block_q
+    nk = c // cfg.block_k
+
+    in_specs = []
+    inputs = []
+    if cfg.has_mask:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, 1, cfg.block_k), lambda b, i, j: (b // cfg.num_heads, j, 0, 0)
+            )
+        )
+        inputs.append(kv_mask)
+    carry_specs = [
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, i, j: (b, i, 0, 0)),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, i, j: (b, i, 0, 0)),
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+    ]
+    in_specs += [
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (cfg.kv_row(b), j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (cfg.kv_row(b), j, 0)),
+    ] + carry_specs
+    inputs += [q, k, v, m, l, acc]
+
+    n_fixed = (1 if cfg.has_mask else 0) + 3
+    return pl.pallas_call(
+        functools.partial(_ring_step_kernel, cfg),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=list(carry_specs),
+        out_shape=[
+            jax.ShapeDtypeStruct(m.shape, jnp.float32),
+            jax.ShapeDtypeStruct(l.shape, jnp.float32),
+            jax.ShapeDtypeStruct(acc.shape, jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_q, 128), jnp.float32),
+            pltpu.VMEM((cfg.block_q, 128), jnp.float32),
+            pltpu.VMEM((cfg.block_q, d), jnp.float32),
+        ],
+        # The carries are read once (j == 0) and written once (j == nk - 1):
+        # alias them through so XLA updates in place instead of copying.
+        input_output_aliases={n_fixed: 0, n_fixed + 1: 1, n_fixed + 2: 2},
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        interpret=cfg.interpret,
+    )(*inputs)
 
 
 # ---------------------------------------------------------------------------
@@ -297,10 +454,16 @@ def _dkdv_kernel(cfg: _FlashConfig, *refs):
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
         mask_ref = None
     j = pl.program_id(1)  # k-block: parallel axis
-    i = pl.program_id(2)  # q-block: sequential accumulation axis
-    nq = pl.num_programs(2)
+    # Sequential accumulation axis walks (group, q-block) pairs: with grouped
+    # kv heads (GQA), grid axis 0 runs over B*H_kv and the q-heads sharing
+    # each kv head are folded in here, so dk/dv accumulate across the whole
+    # group in VMEM scratch with no cross-grid-row write race.
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    nq = nt // cfg.group
+    i = t % nq  # q-block within the current group member
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -328,7 +491,7 @@ def _dkdv_kernel(cfg: _FlashConfig, *refs):
     else:
         _compute()
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == nt - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -336,14 +499,25 @@ def _dkdv_kernel(cfg: _FlashConfig, *refs):
 
 def _bwd(cfg: _FlashConfig, q, k, v, kv_mask, out, lse, do):
     bh, s_q, d = q.shape
-    s_k = k.shape[1]
     nq = s_q // cfg.block_q
-    nk = s_k // cfg.block_k
 
     # Per-row rowsum(do * out) — tiny elementwise op, left to XLA to fuse.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).reshape(bh, nq, cfg.block_q, 1)
+    return flash_chunk_bwd(cfg, q, k, v, kv_mask, lse, delta, do)
+
+
+def flash_chunk_bwd(cfg: _FlashConfig, q, k, v, kv_mask, lse, delta, do):
+    """dq/dk/dv for one (q, KV-chunk) pair given the GLOBAL per-row softmax
+    statistics (lse) and delta = rowsum(do·out). For plain flash attention the
+    chunk is the whole sequence; ring attention calls this once per ring hop
+    (with its local chunk pair) and accumulates — the decomposition is exact
+    because p recomputed from the global lse is the true probability tile."""
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    nq = s_q // cfg.block_q
+    nk = s_k // cfg.block_k
 
     q_spec_i = lambda b, i, j: (b, i, 0)  # noqa: E731
     lse_spec_i = lambda b, i, j: (b, i, 0, 0)  # noqa: E731
@@ -359,8 +533,8 @@ def _bwd(cfg: _FlashConfig, q, k, v, kv_mask, out, lse, do):
         inputs.append(kv_mask)
     in_specs += [
         pl.BlockSpec((1, cfg.block_q, d), q_spec_i),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (cfg.kv_row(b), j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (cfg.kv_row(b), j, 0)),
         pl.BlockSpec((1, cfg.block_q, d), q_spec_i),
         pl.BlockSpec((1, 1, cfg.block_q, 1), lse_spec_i),
         pl.BlockSpec((1, 1, cfg.block_q, 1), lse_spec_i),
@@ -378,37 +552,49 @@ def _bwd(cfg: _FlashConfig, q, k, v, kv_mask, out, lse, do):
         interpret=cfg.interpret,
     )(*inputs)
 
-    # dk/dv: k-blocks parallel, q-blocks sequential.
+    # dk/dv: k-blocks parallel; (group member, q-block) pairs sequential.
+    # Grid axis 0 runs over the FOLDED kv rows (B*H_kv): with grouped kv
+    # heads every q-head sharing a kv head lands on the same grid row, so
+    # its contribution accumulates in the same VMEM scratch.
+    bkv = k.shape[0]
+    group = cfg.group
+
+    def q_row(b, t):
+        # kv grid row b + group member t//nq -> row of the (B*H, ...) arrays.
+        if group == 1:
+            return b
+        return (b // cfg.kv_heads) * cfg.num_heads + (b % cfg.kv_heads) * group + t // nq
+
     in_specs_kv = []
     inputs_kv = []
     if cfg.has_mask:
         in_specs_kv.append(
             pl.BlockSpec(
-                (1, 1, 1, cfg.block_k), lambda b, j, i: (b // cfg.num_heads, j, 0, 0)
+                (1, 1, 1, cfg.block_k), lambda b, j, t: (b // cfg.kv_heads, j, 0, 0)
             )
         )
         inputs_kv.append(kv_mask)
     in_specs_kv += [
-        pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, j, i: (b, i, 0, 0)),
-        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, j, i: (b, i, 0, 0)),
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, j, t: (q_row(b, t), t % nq, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, j, t: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, j, t: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, j, t: (q_row(b, t), t % nq, 0)),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, j, t: (q_row(b, t), t % nq, 0, 0)),
+        pl.BlockSpec((1, 1, cfg.block_q, 1), lambda b, j, t: (q_row(b, t), t % nq, 0, 0)),
     ]
     inputs_kv += [q, k, v, do, lse, delta]
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkdv_kernel, cfg),
-        grid=(bh, nk, nq),
+        grid=(bkv, nk, nq * group),
         in_specs=in_specs_kv,
         out_specs=[
-            pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, cfg.block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, cfg.block_k, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((bkv, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bkv, s_k, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((cfg.block_k, d), jnp.float32),
@@ -460,6 +646,10 @@ def flash_attention(
 
     Args:
       q, k, v: (B, S_q|S_k, H, D). Cross-attention (S_q != S_k) is supported.
+        Grouped-query attention: k/v may carry FEWER heads (B, S_k, H_kv, D)
+        with H % H_kv == 0 — kv stays folded at H_kv rows and the kernel's
+        BlockSpec index maps assign each q-head its kv group, so kv HBM
+        traffic stays at the H_kv rate (no materialized repeat).
       kv_mask: optional (B, S_k) bool/int, True where the key is a real token
         (the padding mask of ``ops.masks.make_padding_mask`` squeezed to 2D).
       causal: structural causal masking (requires S_q == S_k positions to be
@@ -475,6 +665,13 @@ def flash_attention(
         raise ValueError(f"expected (B, S, H, D) inputs, got shape {q.shape}")
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
+    h_kv = k.shape[2]
+    if v.shape[2] != h_kv:
+        raise ValueError(f"k has {h_kv} heads but v has {v.shape[2]}")
+    if h % h_kv:
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {h_kv}"
+        )
     if causal and s_q != s_k:
         raise ValueError("causal flash attention requires S_q == S_k")
     if interpret is None:
@@ -504,11 +701,13 @@ def flash_attention(
         num_heads=h,
         scale=d**-0.5,
         interpret=bool(interpret),
+        num_kv_heads=h_kv,
     )
 
-    # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows.
+    # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows (kv
+    # folds at its own, possibly smaller, head count).
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], x.shape[1], d)
 
     # Pre-tile the mask to (B, nk, 1, block_k): each (1, block_k) tile is a
     # full block under the TPU lane-tiling rules.

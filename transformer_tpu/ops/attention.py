@@ -229,15 +229,15 @@ def mha_apply(
         v = v.astype(dtype)
 
     if (
-        impl in ("flash", "ring", "ulysses")
+        impl in ("ring", "ulysses")
         and cache is None  # decode attends grouped over the small cache
         and k.shape[2] != q.shape[2]
     ):
-        # Grouped-query kv heads: the blockwise kernels are written for equal
-        # head counts, so repeat kv to full heads just for the kernel call.
-        # The GQA wins are kv parameter count and decode-cache size (the
-        # decode path attends grouped, no repeat); in-kernel bandwidth here
-        # matches plain MHA.
+        # Grouped-query kv heads: the ring/ulysses collectives are written
+        # for equal head counts, so repeat kv to full heads for those paths.
+        # The flash kernel needs NO repeat — its BlockSpec index maps assign
+        # each q-head its kv group, keeping kv HBM reads at the H_kv rate
+        # (kernels/flash_attention.py).
         reps = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, reps, axis=2)
         v = jnp.repeat(v, reps, axis=2)

@@ -32,13 +32,208 @@ chunk pairs contribute nothing.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from transformer_tpu.kernels.flash_attention import _MASK_GUARD, _MASKED
+from transformer_tpu.kernels.flash_attention import (
+    _MASKED,
+    _FlashConfig,
+    _largest_divisor_block,
+    flash_chunk_bwd,
+    flash_ring_step,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class _RingConfig:
+    """Static ring configuration (hashable: the nondiff custom-vjp arg)."""
+
+    axis_name: str
+    axis_size: int
+    causal: bool
+    has_mask: bool
+    block_q: int
+    block_k: int
+    num_heads: int
+    scale: float
+    interpret: bool
+
+    def flash(self, causal: bool) -> _FlashConfig:
+        """Kernel config for one chunk pair; ``causal`` means 'this is the
+        diagonal pair' (intra-chunk causality — local coordinates coincide
+        with global ones there)."""
+        return _FlashConfig(
+            causal=causal,
+            has_mask=self.has_mask,
+            block_q=self.block_q,
+            block_k=self.block_k,
+            num_heads=self.num_heads,
+            scale=self.scale,
+            interpret=self.interpret,
+        )
+
+
+def _ring_block(c: int, requested: int) -> int:
+    """A TPU-legal tile size that divides the chunk exactly (no padding in
+    the ring: carries are chunk-shaped): 8-aligned divisor, else the whole
+    chunk (a block equal to the full dim is always legal)."""
+    blk = _largest_divisor_block(c, requested)
+    return blk if blk % 8 == 0 else c
+
+
+def _fold(x: jax.Array) -> jax.Array:
+    """(B, C, H, D) -> (B*H, C, D): heads become independent grid rows."""
+    b, c, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, c, d)
+
+
+def _unfold(x: jax.Array, b: int, h: int) -> jax.Array:
+    bh, c, d = x.shape
+    return x.reshape(b, h, c, d).transpose(0, 2, 1, 3)
+
+
+def _tile_mask(kv_mask: jax.Array | None, block_k: int) -> jax.Array | None:
+    """(B, C) -> the kernels' pre-tiled (B, C/block_k, 1, block_k) int32."""
+    if kv_mask is None:
+        return None
+    b, c = kv_mask.shape
+    return kv_mask.astype(jnp.int32).reshape(b, c // block_k, 1, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring(cfg: _RingConfig, q, k, v, kv_mask):
+    out, _ = _ring_fwd_impl(cfg, q, k, v, kv_mask)
+    return out
+
+
+def _ring_fwd_impl(cfg: _RingConfig, q, k, v, kv_mask):
+    """Forward ring: one ``flash_ring_step`` Pallas call per hop folds the
+    visiting KV chunk into the online-softmax carry — scores exist only as
+    (block_q, block_k) VMEM tiles, never as a (C, C) HBM tensor."""
+    b, c, h, d = q.shape
+    P_ = cfg.axis_size
+    my = jax.lax.axis_index(cfg.axis_name)
+    shift = [(i, (i + 1) % P_) for i in range(P_)]
+    qf = _fold(q)
+    nq = c // cfg.block_q
+    m = jnp.full((b * h, nq, cfg.block_q, 1), _MASKED, jnp.float32)
+    l = jnp.zeros_like(m)
+    acc = jnp.zeros((b * h, c, d), jnp.float32)
+
+    k_cur, v_cur, mask_cur = k, v, kv_mask
+    for t in range(P_):  # unrolled: XLA overlaps each ppermute with compute
+        src = (my - t) % P_  # global index of the chunk visiting this step
+        kf, vf = _fold(k_cur), _fold(v_cur)
+        mt = _tile_mask(mask_cur, cfg.block_k)
+
+        def step(fcfg, m, l, acc, kf=kf, vf=vf, mt=mt):
+            return flash_ring_step(fcfg, qf, kf, vf, mt, m, l, acc)
+
+        if cfg.causal:
+            # The whole chunk pair is below (fold fully), on (fold with
+            # intra-chunk causality), or above the diagonal (skip).
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            m, l, acc = jax.lax.switch(
+                branch,
+                [
+                    functools.partial(step, cfg.flash(False)),
+                    functools.partial(step, cfg.flash(True)),
+                    lambda m, l, acc: (m, l, acc),
+                ],
+                m, l, acc,
+            )
+        else:
+            m, l, acc = step(cfg.flash(False), m, l, acc)
+        if t + 1 < P_:
+            k_cur = jax.lax.ppermute(k_cur, cfg.axis_name, shift)
+            v_cur = jax.lax.ppermute(v_cur, cfg.axis_name, shift)
+            if mask_cur is not None:
+                mask_cur = jax.lax.ppermute(mask_cur, cfg.axis_name, shift)
+
+    l_col = l.reshape(b * h, c, 1)
+    l_safe = jnp.where(l_col == 0.0, 1.0, l_col)
+    out = _unfold((acc / l_safe), b, h).astype(q.dtype)
+    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))  # (B*H, nq, bq, 1)
+    return out, lse
+
+
+def _ring_fwd_rule(cfg, q, k, v, kv_mask):
+    out, lse = _ring_fwd_impl(cfg, q, k, v, kv_mask)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _ring_bwd_rule(cfg, residuals, do):
+    """Ring backward: dq accumulates locally; dk/dv ride the ring WITH their
+    k/v chunks (P hops total, so every chunk's gradient arrives back home
+    with all devices' contributions folded in). Probability tiles are
+    recomputed per chunk from the forward's global logsumexp — the exact
+    flash decomposition, O(block²) VMEM per tile."""
+    q, k, v, kv_mask, out, lse = residuals
+    b, c, h, d = q.shape
+    P_ = cfg.axis_size
+    my = jax.lax.axis_index(cfg.axis_name)
+    shift = [(i, (i + 1) % P_) for i in range(P_)]
+    qf, dof, outf = _fold(q), _fold(do), _fold(out)
+    nq = c // cfg.block_q
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1
+    ).reshape(b * h, nq, cfg.block_q, 1)
+
+    dq = jnp.zeros((b * h, c, d), jnp.float32)
+    dk_cur = jnp.zeros((b * h, c, d), jnp.float32)
+    dv_cur = jnp.zeros((b * h, c, d), jnp.float32)
+    k_cur, v_cur, mask_cur = k, v, kv_mask
+
+    for t in range(P_):
+        src = (my - t) % P_
+        kf, vf = _fold(k_cur), _fold(v_cur)
+        mt = _tile_mask(mask_cur, cfg.block_k)
+
+        def step(fcfg, dq, dk_acc, dv_acc, kf=kf, vf=vf, mt=mt):
+            dq_s, dk_s, dv_s = flash_chunk_bwd(
+                fcfg, qf, kf, vf, mt, lse, delta, dof
+            )
+            return (
+                dq + dq_s.astype(jnp.float32),
+                dk_acc + dk_s.astype(jnp.float32),
+                dv_acc + dv_s.astype(jnp.float32),
+            )
+
+        if cfg.causal:
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            dq, dk_cur, dv_cur = jax.lax.switch(
+                branch,
+                [
+                    functools.partial(step, cfg.flash(False)),
+                    functools.partial(step, cfg.flash(True)),
+                    lambda dq, dk_acc, dv_acc: (dq, dk_acc, dv_acc),
+                ],
+                dq, dk_cur, dv_cur,
+            )
+        else:
+            dq, dk_cur, dv_cur = step(cfg.flash(False), dq, dk_cur, dv_cur)
+        # Rotate EVERY hop (unlike the forward's P-1): after P hops the kv
+        # chunks — and the gradients riding with them — are home again.
+        k_cur = jax.lax.ppermute(k_cur, cfg.axis_name, shift)
+        v_cur = jax.lax.ppermute(v_cur, cfg.axis_name, shift)
+        dk_cur = jax.lax.ppermute(dk_cur, cfg.axis_name, shift)
+        dv_cur = jax.lax.ppermute(dv_cur, cfg.axis_name, shift)
+        if mask_cur is not None:
+            mask_cur = jax.lax.ppermute(mask_cur, cfg.axis_name, shift)
+
+    return (
+        _unfold(dq, b, h).astype(q.dtype),
+        _unfold(dk_cur, b, h).astype(k.dtype),
+        _unfold(dv_cur, b, h).astype(v.dtype),
+        None,
+    )
+
+
+_ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def ring_attention(
@@ -50,8 +245,19 @@ def ring_attention(
     axis_size: int,
     kv_mask: jax.Array | None = None,
     causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """Blockwise ring attention over a sequence-sharded activation.
+
+    The inner loop IS the flash kernel (``kernels.flash_attention``): each
+    ring hop folds the visiting KV chunk into the online-softmax carry with
+    one ``flash_ring_step`` Pallas call, so per-device memory is O(block_q ×
+    block_k) VMEM tiles + the O(C·D) carry — never the (C, C) fp32 score
+    block the r2 XLA-einsum version materialized per hop. The backward pass
+    recomputes probability tiles from the forward's global logsumexp and
+    rotates dk/dv home with their chunks (custom VJP).
 
     Args:
       q, k, v: (B, C, H, D) local chunks, C = S / axis_size. Chunk i on
@@ -60,66 +266,31 @@ def ring_attention(
       axis_size: number of devices on that axis (static Python int — the ring
         is unrolled so XLA can overlap each ppermute with the next matmul).
       kv_mask: optional (B, C) bool, True where the local key is real.
-      causal: structural causal masking across global positions.
+      causal: structural causal masking across global positions (chunk pairs
+        fully above the diagonal skip their kernel launch entirely).
+      block_q, block_k: requested tile sizes; shrunk to TPU-legal divisors
+        of the chunk length.
+      interpret: run the Pallas kernels in interpret mode (default: off-TPU).
 
     Returns (B, C, H, D) in q's dtype.
     """
     b, c, h, d = q.shape
-    my_idx = jax.lax.axis_index(axis_name)
-    scale = d**-0.5
-    # Matmul INPUTS stay in the model dtype (bf16 feeds the MXU at full
-    # rate; fp32 inputs run at 1/8 throughput) and ACCUMULATE in fp32 via
-    # preferred_element_type — the flash kernel's numerics.
-    qt = q.transpose(0, 2, 1, 3)  # (B, H, C, D)
-
-    m = jnp.full((b, h, c, 1), _MASKED, jnp.float32)
-    l = jnp.zeros((b, h, c, 1), jnp.float32)
-    acc = jnp.zeros((b, h, c, d), jnp.float32)
-
-    shift = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-    k_cur, v_cur = k, v
-    mask_cur = kv_mask
-
-    rows = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
-
-    for t in range(axis_size):
-        src = (my_idx - t) % axis_size  # which global chunk we hold this step
-        kt = k_cur.transpose(0, 2, 1, 3)  # (B, H, C, D)
-        vt = v_cur.transpose(0, 2, 1, 3)
-        s = (
-            jnp.einsum(
-                "bhqd,bhkd->bhqk", qt, kt,
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )  # (B, H, C, C) fp32
-        if mask_cur is not None:
-            s = jnp.where(mask_cur[:, None, None, :], s, _MASKED)
-        if causal:
-            # Global row = my_idx*C + r, global col = src*C + c: the whole
-            # chunk pair is below (src < my), on (src == my), or above the
-            # diagonal — where() keeps it branch-free and XLA-friendly.
-            visible = (src * c + cols) <= (my_idx * c + rows)
-            s = jnp.where(visible[None, None], s, _MASKED)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(s > _MASK_GUARD, jnp.exp(s - m_new), 0.0)
-        correction = jnp.exp(m - m_new)
-        l = correction * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * correction + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(q.dtype), vt,
-            preferred_element_type=jnp.float32,
-        )
-        m = m_new
-        if t + 1 < axis_size:
-            k_cur = jax.lax.ppermute(k_cur, axis_name, shift)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, shift)
-            if mask_cur is not None:
-                mask_cur = jax.lax.ppermute(mask_cur, axis_name, shift)
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l_safe).transpose(0, 2, 1, 3)  # (B, C, H, D)
-    return out.astype(q.dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cfg = _RingConfig(
+        axis_name=axis_name,
+        axis_size=axis_size,
+        causal=causal,
+        has_mask=kv_mask is not None,
+        block_q=_ring_block(c, block_q),
+        block_k=_ring_block(c, block_k),
+        num_heads=h,
+        scale=d**-0.5,
+        interpret=bool(interpret),
+    )
+    if kv_mask is not None:
+        kv_mask = jnp.broadcast_to(kv_mask, (b, c))
+    return _ring(cfg, q, k, v, kv_mask)
 
 
 def ulysses_attention(
