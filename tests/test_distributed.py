@@ -112,6 +112,7 @@ class TestPartitionRules:
         assert spec == P("fsdp", None, None)
 
 
+@pytest.mark.slow
 class TestParity:
     """Sharded runs must reproduce single-device numbers (the SURVEY.md §4
     'DP-sharded loss/grads match single-device' requirement)."""
@@ -252,6 +253,7 @@ class TestParity:
             )
 
 
+@pytest.mark.slow
 class TestShardedCheckpoint:
     """FSDP-sharded state must round-trip without materializing any full
     array on the host (VERDICT round 1: the full-gather save contradicted
@@ -364,6 +366,7 @@ class TestShardedCheckpoint:
             np.testing.assert_array_equal(np.asarray(jax.device_get(a)), np.asarray(b))
 
 
+@pytest.mark.slow
 class TestDistributedResume:
     def test_crash_resume_with_sharded_checkpoint(self, tmp_path):
         """Preemption recovery at mesh scale: a DistributedTrainer run that
@@ -431,6 +434,7 @@ class TestDistributedResume:
         assert int(jax.device_get(t3.state.step)) == 4
 
 
+@pytest.mark.slow
 class TestDistributedTrainer:
     def test_fit_runs_and_matches(self, tmp_path):
         mesh = make_mesh(MeshConfig(data=4, fsdp=2))
@@ -451,3 +455,38 @@ class TestDistributedTrainer:
         bad = TrainConfig(batch_size=12, sequence_length=8, epochs=1)
         with pytest.raises(ValueError):
             DistributedTrainer(MODEL, bad, mesh)
+
+
+class TestCompositionMatrix:
+    """The supported-mesh matrix (parallel/distributed.py module docstring)
+    is enforced, not aspirational: the documented pipe×{seq,expert} holes
+    reject with a clear error BEFORE any state is allocated, while the
+    supported combinations are proven elsewhere (pipe×model/fsdp/data in
+    tests/test_pipeline.py, seq in tests/test_sequence_parallel.py, expert
+    in tests/test_moe.py)."""
+
+    def test_pipe_seq_rejected(self):
+        import dataclasses
+
+        model = dataclasses.replace(MODEL, attention_impl="ring")
+        tcfg = TrainConfig(batch_size=4, sequence_length=8, warmup_steps=10)
+        mesh = make_mesh(MeshConfig(data=2, pipe=2, seq=2))
+        with pytest.raises(ValueError, match="pipe>1 composes"):
+            DistributedTrainer(model, tcfg, mesh)
+
+    def test_pipe_expert_rejected(self):
+        import dataclasses
+
+        model = dataclasses.replace(MODEL, moe_experts=4, moe_every=1)
+        tcfg = TrainConfig(batch_size=8, sequence_length=8, warmup_steps=10)
+        mesh = make_mesh(MeshConfig(data=2, pipe=2, expert=2))
+        with pytest.raises(ValueError, match="pipe>1 composes"):
+            DistributedTrainer(model, tcfg, mesh)
+
+    def test_pipe_model_accepted(self):
+        """PP × TP constructs (the full step parity is pinned in
+        tests/test_pipeline.py::TestPipelinedTransformer)."""
+        tcfg = TrainConfig(batch_size=4, sequence_length=8, warmup_steps=10)
+        mesh = make_mesh(MeshConfig(data=2, pipe=2, model=2))
+        trainer = DistributedTrainer(MODEL, tcfg, mesh, log_fn=lambda *_: None)
+        assert trainer is not None

@@ -24,6 +24,10 @@ from transformer_tpu.parallel import (
     unstack_layer_params,
 )
 
+# Heavyweight module (interpret-mode Pallas / 8-device shard_map /
+# multi-process): excluded from the fast path, pytest -m 'not slow'.
+pytestmark = pytest.mark.slow
+
 CFG = ModelConfig(
     num_layers=4,
     d_model=16,
@@ -221,6 +225,111 @@ class TestPipelinedTransformer:
             float(m_pp["loss"]), float(m_dp["loss"]), rtol=1e-5
         )
 
+        new_state, metrics = step_pp(
+            state_pp, put_batch(src, mesh_pp), put_batch(tgt, mesh_pp),
+            jax.random.PRNGKey(3),
+        )
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(jax.device_get(new_state.step)) == 1
+
+    def test_pipe_with_model_axis_matches_plain(self):
+        """PP × TP (r2 VERDICT next-#7): a mesh with pipe AND model axes.
+        The GPipe region goes manual over data/pipe only; the model axis
+        stays GSPMD-auto (pipeline_apply(auto_axes)), so stage interiors
+        keep their heads/dff tensor sharding — and logits must reproduce
+        the plain sequential forward."""
+        mesh = make_mesh(
+            MeshConfig(data=2, pipe=2, model=2), devices=jax.devices()
+        )
+        params = transformer_init(jax.random.PRNGKey(0), CFG)
+        src = _ids(jax.random.PRNGKey(1), 4, 12)
+        tgt = _ids(jax.random.PRNGKey(2), 4, 10)
+        ref, _ = transformer_apply(params, src, tgt, CFG)
+        out = jax.jit(
+            lambda p, s, t: pipelined_transformer_apply(
+                p, s, t, CFG, mesh=mesh, num_microbatches=2,
+                deterministic=True,
+            )
+        )(params, src, tgt)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_pipe_model_sharded_train_step(self):
+        """End-to-end pipe×model through make_sharded_steps (previously a
+        documented rejection): one optimizer step runs and eval parity holds
+        against the plain SPMD step."""
+        from transformer_tpu.config import TrainConfig
+        from transformer_tpu.parallel import (
+            create_sharded_state,
+            make_sharded_steps,
+            put_batch,
+        )
+
+        mesh_ppt = make_mesh(
+            MeshConfig(data=2, pipe=2, model=2), devices=jax.devices()
+        )
+        mesh_dp = _mesh(8, 1)
+        train_cfg = TrainConfig(
+            batch_size=8, sequence_length=12, warmup_steps=10, seed=0
+        )
+        rng = jax.random.PRNGKey(0)
+        src = np.asarray(_ids(jax.random.PRNGKey(1), 8, 12))
+        tgt = np.asarray(_ids(jax.random.PRNGKey(2), 8, 10))
+        state_ppt, sh_ppt = create_sharded_state(rng, CFG, train_cfg, mesh_ppt)
+        step_ppt, eval_ppt = make_sharded_steps(
+            mesh_ppt, CFG, train_cfg, sh_ppt, donate=False
+        )
+        state_dp, sh_dp = create_sharded_state(rng, CFG, train_cfg, mesh_dp)
+        _, eval_dp = make_sharded_steps(mesh_dp, CFG, train_cfg, sh_dp, donate=False)
+        m_ppt = eval_ppt(
+            state_ppt, put_batch(src, mesh_ppt), put_batch(tgt, mesh_ppt)
+        )
+        m_dp = eval_dp(state_dp, put_batch(src, mesh_dp), put_batch(tgt, mesh_dp))
+        np.testing.assert_allclose(
+            float(m_ppt["loss"]), float(m_dp["loss"]), rtol=1e-5
+        )
+        new_state, metrics = step_ppt(
+            state_ppt, put_batch(src, mesh_ppt), put_batch(tgt, mesh_ppt),
+            jax.random.PRNGKey(3),
+        )
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(jax.device_get(new_state.step)) == 1
+
+    def test_pipe_with_chunked_loss_matches_plain(self):
+        """r2 VERDICT next-#5: loss_chunks composes with the GPipe forward —
+        the pipelined hidden forward + chunked vocab-projection CE must match
+        the plain SPMD monolithic loss."""
+        import dataclasses
+
+        from transformer_tpu.config import TrainConfig
+        from transformer_tpu.parallel import (
+            create_sharded_state,
+            make_sharded_steps,
+            put_batch,
+        )
+
+        mesh_pp = _mesh(2, 4)
+        mesh_dp = _mesh(8, 1)
+        plain_cfg = TrainConfig(
+            batch_size=8, sequence_length=12, warmup_steps=10, seed=0
+        )
+        chunk_cfg = dataclasses.replace(plain_cfg, loss_chunks=3)
+        rng = jax.random.PRNGKey(0)
+        src = np.asarray(_ids(jax.random.PRNGKey(1), 8, 12))
+        tgt = np.asarray(_ids(jax.random.PRNGKey(2), 8, 10))
+
+        state_pp, sh_pp = create_sharded_state(rng, CFG, chunk_cfg, mesh_pp)
+        step_pp, eval_pp = make_sharded_steps(
+            mesh_pp, CFG, chunk_cfg, sh_pp, donate=False
+        )
+        state_dp, sh_dp = create_sharded_state(rng, CFG, plain_cfg, mesh_dp)
+        _, eval_dp = make_sharded_steps(
+            mesh_dp, CFG, plain_cfg, sh_dp, donate=False
+        )
+        m_pp = eval_pp(state_pp, put_batch(src, mesh_pp), put_batch(tgt, mesh_pp))
+        m_dp = eval_dp(state_dp, put_batch(src, mesh_dp), put_batch(tgt, mesh_dp))
+        np.testing.assert_allclose(
+            float(m_pp["loss"]), float(m_dp["loss"]), rtol=1e-5
+        )
         new_state, metrics = step_pp(
             state_pp, put_batch(src, mesh_pp), put_batch(tgt, mesh_pp),
             jax.random.PRNGKey(3),

@@ -204,6 +204,45 @@ class TestEarlyStopping:
         assert not any("done in" in l for l in relaunch_logs)  # no training
         assert mgr2.all_steps() == saved_steps  # checkpoints untouched
 
+    def test_plateau_window_survives_resume(self, tmp_path):
+        """Crash-resume keeps the patience window (plateau.json sidecar): a
+        run preempted after a plateau epoch must NOT get a fresh window and
+        train `patience` extra epochs past the original plateau."""
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        # Warmup so large the LR is ~0: eval loss is bit-identical every
+        # epoch, so epoch 1 sets best_eval and every later epoch plateaus.
+        def cfg(epochs):
+            return dataclasses.replace(
+                TCFG, epochs=epochs, warmup_steps=10**9,
+                early_stop_patience=2, eval_every_steps=0, log_every_steps=0,
+                checkpoint_every_epochs=1,
+            )
+
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2, is_primary=True)
+        state = create_train_state(jax.random.PRNGKey(0), TINY, cfg(2))
+        logs = []
+        tr = Trainer(TINY, cfg(2), state, checkpoint=mgr, log_fn=logs.append)
+        tr.fit(_FixedBatches(n=2, seed=0), _FixedBatches(n=1, seed=7))
+        # Epoch 1: best. Epoch 2: one plateau epoch. The exhausted epoch
+        # budget plays the part of the preemption.
+        assert not any("early stop" in l for l in logs)
+        assert (tmp_path / "plateau.json").exists()
+
+        mgr2 = CheckpointManager(str(tmp_path), max_to_keep=2, is_primary=True)
+        state2 = create_train_state(jax.random.PRNGKey(0), TINY, cfg(40))
+        logs2 = []
+        tr2 = Trainer(TINY, cfg(40), state2, checkpoint=mgr2, log_fn=logs2.append)
+        tr2.fit(_FixedBatches(n=2, seed=0), _FixedBatches(n=1, seed=7))
+        assert any("resumed early-stop window" in l for l in logs2), logs2[:3]
+        done = [l for l in logs2 if "done in" in l]
+        # The persisted window already counts 1 plateau epoch, so ONE more
+        # (epoch 3) reaches patience=2 — a fresh window would need two.
+        assert len(done) == 1, logs2
+        assert any("early stop" in l for l in logs2)
+
     def test_empty_eval_gives_no_signal(self):
         """A zero-weight eval (empty test split) must not lock best_eval at
         0.0 and fire a spurious stop."""
@@ -494,12 +533,57 @@ class TestChunkedLoss:
         state, m = jax.jit(make_train_step(cfg, tc))(state, src, tgt, jax.random.PRNGKey(1))
         assert np.isfinite(float(m["loss"]))
 
-    def test_rejects_grad_accum_combination(self):
+    def test_composes_with_grad_accum(self):
+        """Both sequential memory levers at once (r2 VERDICT missing-#3):
+        loss_chunks × grad_accum_steps must reproduce the monolithic
+        whole-batch trajectory."""
         import dataclasses
 
+        import optax
+
         tc = dataclasses.replace(TCFG, loss_chunks=2, grad_accum_steps=2)
-        with pytest.raises(ValueError, match="loss_chunks"):
-            make_train_step(TINY, tc)
+        r = np.random.default_rng(5)
+        src = jnp.asarray(r.integers(1, 28, (8, 8)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 28, (8, 8)), jnp.int32)
+        tgt = tgt.at[:, 6:].set(0)  # pad tail: exercise token weighting
+        rng = jax.random.PRNGKey(3)
+        # SGD so params reflect raw gradient sums: Adam's m/sqrt(v) would
+        # amplify fp32 summation-order noise on near-zero gradients into
+        # O(1) relative update differences (the accum-only test compares
+        # losses for the same reason).
+        from transformer_tpu.train.state import TrainState
+
+        sgd = optax.sgd(0.5)
+        params = create_train_state(jax.random.PRNGKey(0), TINY, TCFG).params
+        s_ref = TrainState(
+            step=jnp.int32(0), params=params, opt_state=sgd.init(params)
+        )
+        s_c = TrainState(
+            step=jnp.int32(0), params=params, opt_state=sgd.init(params)
+        )
+        step_ref = jax.jit(make_train_step(TINY, TCFG, tx=sgd))
+        step_c = jax.jit(make_train_step(TINY, tc, tx=sgd))
+        for _ in range(3):
+            s_ref, m_ref = step_ref(s_ref, src, tgt, rng)
+            s_c, m_c = step_c(s_c, src, tgt, rng)
+            np.testing.assert_allclose(
+                float(m_c["loss"]), float(m_ref["loss"]), rtol=2e-5
+            )
+        for a, b in zip(jax.tree.leaves(s_ref.params), jax.tree.leaves(s_c.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_custom_forward_requires_hidden_forward(self):
+        """A custom forward_fn without its hidden counterpart must still be
+        rejected under loss_chunks — silently materializing (B, S, V) logits
+        would OOM exactly where chunking matters."""
+        import dataclasses
+
+        tc = dataclasses.replace(TCFG, loss_chunks=2)
+        fake_forward = lambda params, s, ti, r, det: None  # noqa: E731
+        with pytest.raises(ValueError, match="hidden_forward_fn"):
+            make_train_step(TINY, tc, forward_fn=fake_forward)
+        with pytest.raises(ValueError, match="hidden_forward_fn"):
+            make_eval_step(TINY, tc, forward_fn=fake_forward)
 
 
 class TestCheckpoint:

@@ -86,6 +86,7 @@ def pipeline_apply(
     param_specs: Params | None = None,
     fsdp_axis: str = "fsdp",
     with_aux: bool = False,
+    auto_axes: tuple[str, ...] = (),
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run a homogeneous layer stack over ``x`` with the GPipe schedule.
 
@@ -107,6 +108,11 @@ def pipeline_apply(
         layer axis) whose ``fsdp_axis`` entries mark dims sharded over fsdp;
         those leaves stay sharded at rest and are gathered per layer inside
         the stage scan. None = stages hold their layers whole.
+      auto_axes: mesh axes left OUT of the manual shard_map region (GSPMD
+        keeps handling them): pass ``("model",)`` to compose the GPipe
+        schedule with tensor parallelism — stage-interior layer math stays
+        model-axis-sharded and XLA inserts the head/dff collectives, while
+        the schedule's ppermute/psum ride the manual ``pipe`` axis.
 
     Returns ``(B, ...)`` outputs, replicated over ``pipe`` — plus, with
     ``with_aux``, a replicated fp32 scalar: the per-layer aux losses summed
@@ -137,12 +143,15 @@ def pipeline_apply(
     M = num_microbatches
     T = M + n_stages - 1
 
+    manual = tuple(a for a in mesh.axis_names if a not in auto_axes)
+
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=(params_spec, bspec, consts_spec, rng_spec),
         out_specs=(bspec, P()) if with_aux else bspec,
         check_vma=False,
+        axis_names=set(manual),
     )
     def _pipelined(local_params, x_local, consts_local, rng):
         batch = x_local.shape[0]
@@ -251,6 +260,7 @@ def pipelined_transformer_apply(
     rng: jax.Array | None = None,
     deterministic: bool = True,
     pad_id: int = 0,
+    return_hidden: bool = False,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Pipeline-parallel counterpart of ``models.transformer.transformer_apply``
     (same logits, no attention-weight plumbing): embedding prologue and final
@@ -260,10 +270,20 @@ def pipelined_transformer_apply(
     Layer params are stacked on entry — callers that jit this (they should)
     pay that restructuring once at trace time.
 
+    A mesh with a ``model`` axis composes: the GPipe region goes manual over
+    {data, fsdp, pipe} only and the ``model`` axis stays GSPMD-auto, so
+    stage-interior layer math keeps its tensor-parallel sharding (heads/dff
+    on ``model``) with XLA-inserted collectives.
+
     MoE models (``cfg.moe_experts > 0``, homogeneous stacks only —
     ``moe_every == 1``) return ``(logits, moe_aux)`` instead of bare logits:
     the layers' load-balance losses ride the schedule as a second scan
     output (``pipeline_apply(with_aux=True)``).
+
+    ``return_hidden=True`` stops before the vocab projection and returns the
+    (B, S, d_model) decoder hiddens (post final-LN for pre-LN stacks) — the
+    pipelined counterpart of ``transformer_hidden_apply``, for the chunked
+    vocab-projection/CE path (``TrainConfig.loss_chunks``).
     """
     from transformer_tpu.models.decoder import decoder_layer_apply
     from transformer_tpu.models.encoder import embed_prologue, encoder_layer_apply
@@ -277,6 +297,10 @@ def pipelined_transformer_apply(
         r_embed_e, r_embed_d, r_enc, r_dec = jax.random.split(rng, 4)
 
     moe = bool(cfg.moe_experts)
+    # Tensor parallelism composes by exclusion: the 'model' axis stays out
+    # of the manual region (GSPMD-auto), so stage interiors keep their
+    # heads/dff sharding with XLA-inserted collectives.
+    auto = ("model",) if mesh.shape.get("model", 1) > 1 else ()
 
     if cfg.decoder_only:
         self_mask = make_padding_mask(tar, pad_id)
@@ -297,7 +321,7 @@ def pipelined_transformer_apply(
             stacked, dec_layer, x, (self_mask,),
             mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
             param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
-            with_aux=moe,
+            with_aux=moe, auto_axes=auto,
         )
         if moe:
             x, aux = x
@@ -305,6 +329,8 @@ def pipelined_transformer_apply(
             x = layernorm_apply(
                 params["decoder"]["final_ln"], x, cfg.layernorm_epsilon
             )
+        if return_hidden:
+            return (x, aux) if moe else x
         logits = _logits(params, x, cfg)
         return (logits, aux) if moe else logits
 
@@ -329,7 +355,7 @@ def pipelined_transformer_apply(
         enc_stacked, enc_layer, x, (enc_mask,),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_enc,
         param_specs=_layer_fsdp_specs(params["encoder"]["layers"][0], mesh),
-        with_aux=moe,
+        with_aux=moe, auto_axes=auto,
     )
     enc_aux = None
     if moe:
@@ -356,7 +382,7 @@ def pipelined_transformer_apply(
         dec_stacked, dec_layer, y, (enc_out, self_mask, enc_mask),
         mesh=mesh, num_microbatches=num_microbatches, base_rng=r_dec,
         param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
-        with_aux=moe,
+        with_aux=moe, auto_axes=auto,
     )
     if moe:
         y, dec_aux = y
@@ -364,5 +390,7 @@ def pipelined_transformer_apply(
         y = layernorm_apply(
             params["decoder"]["final_ln"], y, cfg.layernorm_epsilon
         )
+    if return_hidden:
+        return (y, enc_aux + dec_aux) if moe else y
     logits = _logits(params, y, cfg)
     return (logits, enc_aux + dec_aux) if moe else logits
