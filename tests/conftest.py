@@ -22,3 +22,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests (interpret-mode Pallas kernels, 8-device "
+        "shard_map, multi-process) — `pytest -m 'not slow'` is the fast "
+        "core-parity path (see README)",
+    )

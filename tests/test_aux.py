@@ -123,6 +123,7 @@ class TestPreemptionGuard:
         assert ckpt.latest_step == 3
         assert any("preemption" in msg for msg in logs)
 
+    @pytest.mark.slow
     def test_resume_after_preemption(self, tmp_path):
         """The saved preemption checkpoint restores at next start."""
         tcfg = TrainConfig(
@@ -160,6 +161,7 @@ class TestTreeChecksum:
         p2 = jax.tree.map(lambda x: x + 1e-3, p1)
         assert tree_checksum(p1) != tree_checksum(p2)
 
+    @pytest.mark.slow
     def test_train_determinism_audit(self):
         """Two identical runs of the jitted step must produce bit-identical
         states — the cross-run determinism guarantee the audit relies on."""

@@ -207,6 +207,7 @@ class TestLengthBuckets:
                 length_buckets=(4, 6),
             )
 
+    @pytest.mark.slow
     def test_trains_through_trainer(self):
         """End-to-end: a jitted train step accepts both bucket widths (one
         compile each, no errors from the changing static shape)."""

@@ -18,6 +18,10 @@ from transformer_tpu.kernels.flash_attention import flash_attention
 from transformer_tpu.models import transformer_apply, transformer_init
 from transformer_tpu.ops.attention import dot_product_attention
 
+# Heavyweight module (interpret-mode Pallas / 8-device shard_map /
+# multi-process): excluded from the fast path, pytest -m 'not slow'.
+pytestmark = pytest.mark.slow
+
 
 def _qkv(rng, b=2, s=64, h=2, d=32, dtype=jnp.float32):
     mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)  # noqa: E731

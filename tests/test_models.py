@@ -135,6 +135,7 @@ class TestTransformer:
         l2 = fwd(params, inp, tar)
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
 
+    @pytest.mark.slow
     def test_remat_matches_plain(self):
         """cfg.remat must change memory behavior only: forward logits and
         gradients identical to the non-remat model."""

@@ -18,6 +18,10 @@ from transformer_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from transformer_tpu.ops.ffn import ffn_apply
 from transformer_tpu.ops.moe import expert_capacity, moe_apply, moe_init
 
+# Heavyweight module (interpret-mode Pallas / 8-device shard_map /
+# multi-process): excluded from the fast path, pytest -m 'not slow'.
+pytestmark = pytest.mark.slow
+
 MOE_TINY = ModelConfig(
     num_layers=2, d_model=32, num_heads=4, dff=64,
     input_vocab_size=50, target_vocab_size=50, max_position=16,

@@ -15,6 +15,11 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
+
+# Heavyweight module (interpret-mode Pallas / 8-device shard_map /
+# multi-process): excluded from the fast path, pytest -m 'not slow'.
+pytestmark = pytest.mark.slow
 
 
 def _free_port() -> int:
