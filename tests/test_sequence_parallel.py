@@ -75,6 +75,40 @@ class TestSequenceParallelAttention:
             np.testing.assert_allclose(g, w, atol=2e-5)
 
 
+def test_ring_grouped_kv_matches_oracle(seq_mesh):
+    """GQA through the ring with NO kv repeat: kv enters at H_kv heads, the
+    kernels' index maps assign each q-head its group, and the per-hop
+    ppermute payload shrinks by the group factor. Forward and all grads
+    must match the grouped XLA oracle (kv grads stay at H_kv heads)."""
+    rng = np.random.default_rng(3)
+    H, Hkv = 8, 2
+    q = jnp.asarray(rng.normal(size=(2, 64, H, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 64, Hkv, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 64, Hkv, 16)), jnp.float32)
+    kv_mask = jnp.asarray(rng.integers(0, 2, (2, 64)), bool).at[:, :2].set(True)
+    fn = make_sequence_parallel_attention(seq_mesh, impl="ring")
+    mask = jnp.logical_and(
+        jnp.tril(jnp.ones((64, 64), bool))[None, None],
+        kv_mask[:, None, None, :],
+    )
+    want, _ = dot_product_attention(q, k, v, mask)
+    np.testing.assert_allclose(
+        fn(q, k, v, kv_mask=kv_mask, causal=True), want, atol=1e-5
+    )
+
+    def f_sp(q, k, v):
+        return (fn(q, k, v, kv_mask=kv_mask, causal=True) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (dot_product_attention(q, k, v, mask)[0] ** 2).sum()
+
+    got = jax.grad(f_sp, argnums=(0, 1, 2))(q, k, v)
+    want_g = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want_g):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(g, w, atol=2e-5)
+
+
 def test_ring_bf16_matches_full_attention(seq_mesh):
     """bf16 inputs (the TPU training dtype): ring must agree with plain
     attention at bf16 tolerance — inputs feed the MXU in bf16, accumulation
@@ -235,6 +269,49 @@ class TestSeqParallelTraining:
         got = self._mesh_losses(
             model, tcfg, batches, MeshConfig(data=1, seq=8)
         )
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    def test_gqa_ring_matches_single_device(self):
+        """Grouped-query kv rides the ring at H_kv heads (no repeat) inside
+        a full training step."""
+        import dataclasses
+
+        model, tcfg = self._configs("ring")
+        model = dataclasses.replace(model, num_kv_heads=2)
+        ref_model = dataclasses.replace(model, attention_impl="xla")
+        batches = self._batches(3)
+        want = self._single_losses(ref_model, tcfg, batches)
+        got = self._mesh_losses(model, tcfg, batches, MeshConfig(data=4, seq=2))
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    @pytest.mark.parametrize(
+        "impl,kv_heads,mesh_kw",
+        [
+            # H_kv=2 % model=2 == 0: kv head blocks align with q head
+            # blocks — kv rides sharded at H_kv heads.
+            ("ring", 2, dict(data=2, model=2, seq=2)),
+            # MQA H_kv=1 on model=2: alignment impossible — the repeat
+            # fallback in seq_parallel_attention must fire.
+            ("ring", 1, dict(data=2, model=2, seq=2)),
+            # H_kv=2 % seq=2 == 0: kv all-to-alls at its own head count.
+            ("ulysses", 2, dict(data=4, seq=2)),
+            # MQA H_kv=1 on seq=2: head all-to-all can't split 1 — repeat
+            # fallback again.
+            ("ulysses", 1, dict(data=4, seq=2)),
+        ],
+    )
+    def test_grouped_kv_sharding_corners(self, impl, kv_heads, mesh_kw):
+        """Every branch of the grouped-kv spec/fallback logic in
+        seq_context.seq_parallel_attention, against the single-device
+        oracle."""
+        import dataclasses
+
+        model, tcfg = self._configs(impl)
+        model = dataclasses.replace(model, num_kv_heads=kv_heads)
+        ref_model = dataclasses.replace(model, attention_impl="xla")
+        batches = self._batches(2)
+        want = self._single_losses(ref_model, tcfg, batches)
+        got = self._mesh_losses(model, tcfg, batches, MeshConfig(**mesh_kw))
         np.testing.assert_allclose(got, want, rtol=2e-4)
 
     def test_ring_with_chunked_loss_matches_monolithic(self):

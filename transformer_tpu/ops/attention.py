@@ -228,20 +228,12 @@ def mha_apply(
         k = k.astype(dtype)
         v = v.astype(dtype)
 
-    if (
-        impl in ("ring", "ulysses")
-        and cache is None  # decode attends grouped over the small cache
-        and k.shape[2] != q.shape[2]
-    ):
-        # Grouped-query kv heads: the ring/ulysses collectives are written
-        # for equal head counts, so repeat kv to full heads for those paths.
-        # The flash kernel needs NO repeat — its BlockSpec index maps assign
-        # each q-head its kv group, keeping kv HBM reads at the H_kv rate
-        # (kernels/flash_attention.py).
-        reps = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
-
+    # Grouped-query kv heads need NO materialized repeat on any blockwise
+    # path: flash and ring map each q-head to its kv group in the kernels'
+    # BlockSpec index maps (kv HBM reads — and the ring's per-hop ppermute
+    # payload — stay at the H_kv rate), and ulysses all-to-alls kv at its
+    # own head count when divisible (seq_context.seq_parallel_attention
+    # repeats only in the two documented misalignment corners).
     if impl == "flash" and cache is None:
         # Causality stays structural (a static kernel flag) so the Pallas
         # kernel can skip above-diagonal tiles instead of masking them.

@@ -61,6 +61,7 @@ class _RingConfig:
     num_heads: int
     scale: float
     interpret: bool
+    num_kv_heads: int = 0  # 0 = same as num_heads (plain MHA)
 
     def flash(self, causal: bool) -> _FlashConfig:
         """Kernel config for one chunk pair; ``causal`` means 'this is the
@@ -74,6 +75,7 @@ class _RingConfig:
             num_heads=self.num_heads,
             scale=self.scale,
             interpret=self.interpret,
+            num_kv_heads=self.num_kv_heads,
         )
 
 
@@ -183,9 +185,10 @@ def _ring_bwd_rule(cfg, residuals, do):
         dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1
     ).reshape(b * h, nq, cfg.block_q, 1)
 
+    h_kv = k.shape[2]
     dq = jnp.zeros((b * h, c, d), jnp.float32)
-    dk_cur = jnp.zeros((b * h, c, d), jnp.float32)
-    dv_cur = jnp.zeros((b * h, c, d), jnp.float32)
+    dk_cur = jnp.zeros((b * h_kv, c, d), jnp.float32)
+    dv_cur = jnp.zeros((b * h_kv, c, d), jnp.float32)
     k_cur, v_cur, mask_cur = k, v, kv_mask
 
     for t in range(P_):
@@ -227,8 +230,8 @@ def _ring_bwd_rule(cfg, residuals, do):
 
     return (
         _unfold(dq, b, h).astype(q.dtype),
-        _unfold(dk_cur, b, h).astype(k.dtype),
-        _unfold(dv_cur, b, h).astype(v.dtype),
+        _unfold(dk_cur, b, h_kv).astype(k.dtype),
+        _unfold(dv_cur, b, h_kv).astype(v.dtype),
         None,
     )
 
@@ -261,7 +264,11 @@ def ring_attention(
 
     Args:
       q, k, v: (B, C, H, D) local chunks, C = S / axis_size. Chunk i on
-        device i covers global positions [i*C, (i+1)*C).
+        device i covers global positions [i*C, (i+1)*C). Grouped-query
+        attention: k/v may carry FEWER heads (B, C, H_kv, D) with
+        H % H_kv == 0 — kv stays at H_kv heads through the whole ring, so
+        both the Pallas tiles AND the per-hop ppermute payload shrink by
+        the group factor (the GQA bandwidth win extends to ICI).
       axis_name: mesh axis the sequence is sharded over (bound in shard_map).
       axis_size: number of devices on that axis (static Python int — the ring
         is unrolled so XLA can overlap each ppermute with the next matmul).
@@ -275,6 +282,11 @@ def ring_attention(
     Returns (B, C, H, D) in q's dtype.
     """
     b, c, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {h_kv}"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     cfg = _RingConfig(
@@ -287,6 +299,7 @@ def ring_attention(
         num_heads=h,
         scale=d**-0.5,
         interpret=bool(interpret),
+        num_kv_heads=h_kv,
     )
     if kv_mask is not None:
         kv_mask = jnp.broadcast_to(kv_mask, (b, c))
@@ -305,11 +318,24 @@ def ulysses_attention(
 ) -> jax.Array:
     """Ulysses-style sequence parallelism: all-to-all from sequence-sharded
     (B, C, H, D) to head-sharded (B, S, H/P, D), full-sequence attention per
-    device, and all-to-all back. Requires H % axis_size == 0."""
+    device, and all-to-all back. Requires H % axis_size == 0.
+
+    Grouped-query kv (k/v with H_kv < H heads, H % H_kv == 0) rides the
+    all-to-all at its own head count when H_kv % axis_size == 0: each device
+    then holds q-head block i and kv-head block i, which pair exactly (local
+    group == global group), and the kv all-to-all payload shrinks by the
+    group factor. Callers fall back to repeating kv when H_kv doesn't divide
+    the axis (``seq_context.seq_parallel_attention``)."""
     b, c, h, d = q.shape
+    h_kv = k.shape[2]
     if h % axis_size:
         raise ValueError(
             f"ulysses needs num_heads ({h}) divisible by the seq axis ({axis_size})"
+        )
+    if h_kv % axis_size:
+        raise ValueError(
+            f"ulysses with grouped kv needs kv heads ({h_kv}) divisible by "
+            f"the seq axis ({axis_size}); repeat kv to full heads first"
         )
 
     def seq_to_heads(x):  # (B, C, H, D) -> (B, S, H/P, D)

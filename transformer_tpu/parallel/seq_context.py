@@ -21,6 +21,7 @@ import dataclasses
 import functools
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -88,6 +89,22 @@ def seq_parallel_attention(
         else None
     )
     act = P(bdim, ctx.axis, hdim, None)
+    # Grouped-query kv normally rides at H_kv heads (the GQA bandwidth win
+    # extends to the ring's ppermute / ulysses' all-to-all payloads): kv
+    # heads block-shard over the model axis exactly like q heads, keeping
+    # the per-shard group mapping aligned (q-head block i pairs with
+    # kv-head block i). Two corners where that alignment is impossible fall
+    # back to repeating kv to full heads (replicating kv heads under
+    # sharded q heads would MISALIGN the groups, so repeat is the only
+    # correct fallback): H_kv not divisible by the model axis, or — for
+    # ulysses, whose all-to-all splits the head dim — by the seq axis.
+    if k.shape[2] != q.shape[2] and (
+        (hdim is not None and k.shape[2] % mesh.shape[hdim])
+        or (impl == "ulysses" and k.shape[2] % sp)
+    ):
+        reps = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
     fn = functools.partial(inner, axis_name=ctx.axis, axis_size=sp, causal=causal)
     if kv_mask is None:
         sharded = jax.shard_map(
