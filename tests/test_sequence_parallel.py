@@ -298,6 +298,10 @@ class TestSeqParallelTraining:
             # MQA H_kv=1 on seq=2: head all-to-all can't split 1 — repeat
             # fallback again.
             ("ulysses", 1, dict(data=4, seq=2)),
+            # Ulysses under a model axis: LOCAL kv heads (2/2 = 1) don't
+            # divide seq=2 even though the global count does — the fallback
+            # must consult the per-shard head count (review finding).
+            ("ulysses", 2, dict(data=2, model=2, seq=2)),
         ],
     )
     def test_grouped_kv_sharding_corners(self, impl, kv_heads, mesh_kw):

@@ -346,18 +346,20 @@ def ulysses_attention(
 
     q_full, k_full, v_full = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
 
-    mask = None
-    if kv_mask is not None:
-        full_kv = jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)  # (B, S)
-        mask = full_kv[:, None, None, :]
-    if causal:
-        s_full = q_full.shape[1]
-        cmask = jnp.tril(jnp.ones((s_full, s_full), dtype=jnp.bool_))[None, None]
-        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+    # Per-device full-sequence attention runs the FLASH kernel, not the
+    # dense XLA path: at the long-context shapes the seq axis exists for,
+    # a dense (S, S) causal mask + score tensor per device would be the
+    # exact O(S²) HBM blow-up sequence parallelism is meant to avoid.
+    # Causality stays structural (above-diagonal tiles skip their launch)
+    # and key padding rides as a (B, S) vector.
+    full_kv = (
+        jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)  # (B, S)
+        if kv_mask is not None
+        else None
+    )
+    from transformer_tpu.kernels.flash_attention import flash_attention
 
-    from transformer_tpu.ops.attention import dot_product_attention
-
-    out, _ = dot_product_attention(q_full, k_full, v_full, mask)
+    out = flash_attention(q_full, k_full, v_full, kv_mask=full_kv, causal=causal)
     return heads_to_seq(out)
 
 

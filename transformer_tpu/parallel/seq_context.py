@@ -98,13 +98,19 @@ def seq_parallel_attention(
     # sharded q heads would MISALIGN the groups, so repeat is the only
     # correct fallback): H_kv not divisible by the model axis, or — for
     # ulysses, whose all-to-all splits the head dim — by the seq axis.
-    if k.shape[2] != q.shape[2] and (
-        (hdim is not None and k.shape[2] % mesh.shape[hdim])
-        or (impl == "ulysses" and k.shape[2] % sp)
-    ):
-        reps = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
+    if k.shape[2] != q.shape[2]:
+        model_misaligned = hdim is not None and k.shape[2] % mesh.shape[hdim]
+        # Ulysses runs PER MODEL-SHARD, so its head all-to-all must divide
+        # the LOCAL kv head count (global // model axis when block-sharded).
+        local_kv = (
+            k.shape[2]
+            if model_misaligned or hdim is None
+            else k.shape[2] // mesh.shape[hdim]
+        )
+        if model_misaligned or (impl == "ulysses" and local_kv % sp):
+            reps = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
     fn = functools.partial(inner, axis_name=ctx.axis, axis_size=sp, causal=causal)
     if kv_mask is None:
         sharded = jax.shard_map(
