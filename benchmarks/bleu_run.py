@@ -64,6 +64,12 @@ def main() -> None:
         "path, where bf16 matmuls are emulated)",
     )
     ap.add_argument(
+        "--native_loader", type=int, default=1,
+        help="1 (default): assemble batches in the C++ prefetching loader "
+        "(composes with the length buckets), overlapping host batch "
+        "assembly with device steps; 0: Python batcher",
+    )
+    ap.add_argument(
         "--bleu_every", type=int, default=0,
         help="also score a 64-pair BLEU probe every N epochs during "
         "training (0 = end-of-run only)",
@@ -126,6 +132,7 @@ def main() -> None:
         seed=0,
         length_buckets=buckets,
         exclude_test_overlap=bool(args.holdout),
+        prefetch=bool(args.native_loader),
     )
     if args.holdout:
         print(

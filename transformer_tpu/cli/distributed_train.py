@@ -89,7 +89,7 @@ def main(argv) -> None:
             seed=train_cfg.seed,
             shard_index=jax.process_index(),
             shard_count=jax.process_count(),
-            prefetch=FLAGS.native_loader and not buckets,
+            prefetch=FLAGS.native_loader,  # composes with length_buckets (native bucketed plan)
             length_buckets=buckets,
         )
     model_cfg = flags_to_model_config(

@@ -79,7 +79,7 @@ def main(argv) -> None:
             sequence_length=train_cfg.sequence_length,
             target_vocab_size=FLAGS.target_vocab_size,
             seed=train_cfg.seed,
-            prefetch=FLAGS.native_loader and not buckets,
+            prefetch=FLAGS.native_loader,  # composes with length_buckets (native bucketed plan)
             length_buckets=buckets,
         )
     logging.info(

@@ -117,9 +117,11 @@ class Seq2SeqDataset:
     length_buckets: tuple[int, ...] = ()
     # Opt-in C++ prefetching loader (transformer_tpu/native/dataloader.cc):
     # batch assembly runs in a background thread, overlapped with device
-    # steps. Shuffle order differs from the Python path (splitmix64
-    # Fisher-Yates vs numpy Philox) but is equally deterministic per
-    # (seed, epoch); the unshuffled order and padding semantics are identical.
+    # steps. Composes with length_buckets (per-bucket batches at bucket
+    # width, plan interleaved). Shuffle order differs from the Python path
+    # (splitmix64 Fisher-Yates vs numpy Philox) but is equally deterministic
+    # per (seed, epoch); the unshuffled order and padding semantics are
+    # identical.
     prefetch: bool = False
     _native: object = dataclasses.field(
         default=None, init=False, repr=False, compare=False
@@ -135,11 +137,6 @@ class Seq2SeqDataset:
             )
         if self.length_buckets:
             self.length_buckets = tuple(sorted(self.length_buckets))
-            if self.prefetch:
-                raise ValueError(
-                    "length_buckets is not supported with the native "
-                    "prefetch loader; pass prefetch=False"
-                )
             if self.length_buckets[-1] > max(self.src_len, self.tgt_len):
                 raise ValueError(
                     f"largest bucket {self.length_buckets[-1]} exceeds the "
@@ -192,15 +189,13 @@ class Seq2SeqDataset:
                     self.src, self.tgt, self.batch_size, local,
                     self.shard_index * local, self.src_len, self.tgt_len,
                     pad_id=PAD_ID,
+                    length_buckets=self.length_buckets,
                 )
                 or False
             )
         return self._native or None
 
     def batches(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        if self.length_buckets:
-            yield from self._bucketed_batches(epoch)
-            return
         if self.prefetch:
             loader = self._native_loader()
             if loader is not None:
@@ -227,6 +222,9 @@ class Seq2SeqDataset:
                 RuntimeWarning,
                 stacklevel=2,
             )
+        if self.length_buckets:
+            yield from self._bucketed_batches(epoch)
+            return
         order = np.arange(len(self.src))
         if self.shuffle:
             rng = np.random.default_rng((self.seed, epoch))
@@ -472,7 +470,7 @@ def load_dataset(
         seed=seed,
         shard_index=shard_index,
         shard_count=shard_count,
-        prefetch=prefetch,  # Seq2SeqDataset rejects prefetch+buckets itself
+        prefetch=prefetch,  # composes with length_buckets (native bucketed plan)
         length_buckets=length_buckets,
     )
 

@@ -126,6 +126,7 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_int32, ctypes.c_int32,  # src_len/tgt_len
         ctypes.c_int32,  # pad_id
         ctypes.c_int32,  # queue_depth
+        i32p, ctypes.c_int32,  # bucket widths, n_buckets (0 = unbucketed)
     ]
     lib.tpu_dl_free.restype = None
     lib.tpu_dl_free.argtypes = [ctypes.c_void_p]
@@ -134,19 +135,25 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.tpu_dl_next.restype = ctypes.c_int32
-    lib.tpu_dl_next.argtypes = [ctypes.c_void_p, i32p, i32p]
+    lib.tpu_dl_next.argtypes = [ctypes.c_void_p, i32p, i32p, i32p]
 
 
 class NativeBatchLoader:
     """ctypes handle to the C++ prefetching loader; owns the native object."""
 
     def __init__(self, handle: int, lib: ctypes.CDLL, local_batch: int,
-                 src_len: int, tgt_len: int):
+                 src_len: int, tgt_len: int, bucketed: bool = False):
         self._handle = ctypes.c_void_p(handle)
         self._lib = lib
         self.local_batch = local_batch
-        self.src_len = src_len
-        self.tgt_len = tgt_len
+        # Receive-buffer capacities: bucket widths apply to BOTH sides and
+        # are bounded by max(src_len, tgt_len), so bucketed buffers must be
+        # sized at that max on each side (mirrors the C++ slot sizing).
+        if bucketed:
+            self.src_len = self.tgt_len = max(src_len, tgt_len)
+        else:
+            self.src_len = src_len
+            self.tgt_len = tgt_len
         self._generation = 0  # starting an epoch invalidates prior iterators
 
     def __del__(self):  # noqa: D105
@@ -166,6 +173,7 @@ class NativeBatchLoader:
         tgt_len: int,
         pad_id: int = 0,
         queue_depth: int = 3,
+        length_buckets: tuple = (),
     ) -> "NativeBatchLoader | None":
         lib = get_lib()
         if lib is None:
@@ -184,6 +192,7 @@ class NativeBatchLoader:
             if len(tgt)
             else np.zeros(0, np.int32)
         )
+        buckets = np.asarray(sorted(length_buckets), dtype=np.int32)
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
         handle = lib.tpu_dl_create(
@@ -191,13 +200,18 @@ class NativeBatchLoader:
             tgt_flat.ctypes.data_as(i32p), tgt_off.ctypes.data_as(i64p),
             len(src), global_batch, local_batch, lo, src_len, tgt_len,
             pad_id, queue_depth,
+            buckets.ctypes.data_as(i32p), len(buckets),
         )
         return (
-            cls(handle, lib, local_batch, src_len, tgt_len) if handle else None
+            cls(handle, lib, local_batch, src_len, tgt_len,
+                bucketed=len(buckets) > 0)
+            if handle
+            else None
         )
 
     def epoch(self, seed: int, shuffle: bool, drop_remainder: bool):
-        """Start the producer and yield (src, tgt) int32 batches.
+        """Start the producer and yield (src, tgt) int32 batches (bucketed
+        loaders yield each batch at its bucket width).
 
         One live iterator per loader: starting a new epoch cancels the
         in-flight one (its iterator terminates cleanly at the next pull
@@ -214,14 +228,22 @@ class NativeBatchLoader:
         while self._generation == my_generation:
             src = np.empty((self.local_batch, self.src_len), dtype=np.int32)
             tgt = np.empty((self.local_batch, self.tgt_len), dtype=np.int32)
+            widths = np.empty(2, dtype=np.int32)
             ok = self._lib.tpu_dl_next(
                 self._handle,
                 src.ctypes.data_as(i32p),
                 tgt.ctypes.data_as(i32p),
+                widths.ctypes.data_as(i32p),
             )
             if not ok:
                 return
-            yield src, tgt
+            sw, tw = int(widths[0]), int(widths[1])
+            # The C++ side packs rows at the batch's own stride; reshape the
+            # filled prefix rather than slicing the max-width view.
+            yield (
+                src.reshape(-1)[: self.local_batch * sw].reshape(self.local_batch, sw),
+                tgt.reshape(-1)[: self.local_batch * tw].reshape(self.local_batch, tw),
+            )
 
 
 class NativeTokenizer:
