@@ -98,6 +98,12 @@ def bench_config(
     - smallvocab: train step with a 2k-row OUTPUT vocab (input embedding
                   untouched) — isolates the vocab-projection/CE share
                   (32k-vocab logits matmul is the prime MFU suspect at seq 64)
+    - deviceloop: all n_steps run inside ONE jitted lax.scan, so the host
+                  dispatches once — (full − deviceloop) throughput is the
+                  per-step dispatch/tunnel overhead share, the prime
+                  suspect for the low measured MFU at batch 64 × seq 64
+                  (BASELINE.md r2 analysis). Same math as `full`: the scan
+                  carries the donated state through real optimizer steps.
 
     ``loss_chunks > 1`` additionally runs the chunked vocab-projection/CE
     path (TrainConfig.loss_chunks) for A/B against the monolithic loss.
@@ -146,6 +152,20 @@ def bench_config(
     if mode == "fwd":
         eval_step = jax.jit(make_eval_step(model_cfg, train_cfg))
         step = lambda state, src, tgt, rng: (state, eval_step(state, src, tgt))  # noqa: E731
+    elif mode == "deviceloop":
+        inner = make_train_step(model_cfg, train_cfg)
+
+        def scan_steps(state, src, tgt, rng):
+            def body(s, _):
+                s, m = inner(s, src, tgt, rng)
+                return s, None
+
+            state, _ = jax.lax.scan(body, state, None, length=n_steps)
+            # One per-scan metrics read keeps the VALUE-fetch sync contract.
+            state, metrics = inner(state, src, tgt, rng)
+            return state, metrics
+
+        step = jax.jit(scan_steps, donate_argnums=(0,) if donate else ())
     else:
         step = jax.jit(
             make_train_step(model_cfg, train_cfg),
@@ -154,7 +174,7 @@ def bench_config(
     if not donate:
         print(f"{name}: tied weights, benchmarking undonated", file=sys.stderr)
 
-    for _ in range(3):  # compile + settle
+    for _ in range(2 if mode == "deviceloop" else 3):  # compile + settle
         state, metrics = step(state, src, tgt, rng)
     # Synchronize via a VALUE fetch, not block_until_ready: on tunneled/
     # remote PJRT backends block_until_ready can return before device
@@ -168,10 +188,18 @@ def bench_config(
     )
     with ctx:
         t0 = time.perf_counter()
-        for _ in range(n_steps):
+        if mode == "deviceloop":
+            # ONE dispatch covering n_steps+1 optimizer steps on device
+            # (n_steps in the scan + the metrics step); normalize to
+            # per-optimizer-step time.
             state, metrics = step(state, src, tgt, rng)
-        final_loss = float(metrics["loss"])
-        dt = time.perf_counter() - t0
+            final_loss = float(metrics["loss"])
+            dt = (time.perf_counter() - t0) * (n_steps / (n_steps + 1.0))
+        else:
+            for _ in range(n_steps):
+                state, metrics = step(state, src, tgt, rng)
+            final_loss = float(metrics["loss"])
+            dt = time.perf_counter() - t0
     assert final_loss == final_loss, "NaN loss"  # keep the fetch load-bearing
 
     tokens_per_step = batch * (seq - 1)
@@ -211,7 +239,9 @@ def main() -> None:
     )
     ap.add_argument(
         "--modes", default="full",
-        help="comma-separated subset of full,fwd,smallvocab (time attribution)",
+        help="comma-separated subset of full,fwd,smallvocab,deviceloop "
+        "(step-time attribution; deviceloop = all steps in one jitted scan, "
+        "isolating per-step dispatch overhead)",
     )
     ap.add_argument(
         "--profile_dir", default="",
@@ -233,6 +263,10 @@ def main() -> None:
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    known = {"full", "fwd", "smallvocab", "deviceloop"}
+    bad = [m for m in modes if m not in known]
+    if bad:  # an unknown mode would silently time the full step mislabeled
+        ap.error(f"unknown mode(s) {bad}; choose from {sorted(known)}")
 
     if len(names) * len(modes) > 1:
         # One subprocess per measurement: a backend error (e.g. a rejected

@@ -97,6 +97,8 @@ missing_extras() {
     || out="$out,chunks=4"
   grep -qF '"metric": "base train throughput [b256xs64]", "value"' "$EXTRA" 2>/dev/null \
     || out="$out,b256xs64"
+  grep -qF '"metric": "base train throughput [deviceloop]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,deviceloop"
   [ "$(value_count "base train throughput" "$EXTRA")" -ge 2 ] || out="$out,repbase"
   [ "$(value_count "tiny train throughput" "$EXTRA")" -ge 2 ] || out="$out,reptiny"
   echo "${out#,}"
@@ -219,6 +221,12 @@ while :; do
         timeout 2400 python benchmarks/run.py --configs base --batch 256 >>"$EXTRA" 2>>"$ERR"
         rc=$?
         [ "$rc" -ne 0 ] && record_failure "base train throughput [b256xs64]" "$EXTRA" "$rc"
+        ;;
+      deviceloop)
+        log "running extra: base device-loop dispatch-overhead probe"
+        timeout 2400 python benchmarks/run.py --configs base --modes deviceloop >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "base train throughput [deviceloop]" "$EXTRA" "$rc"
         ;;
       repbase)
         log "running extra: base repeat row (variance/median)"

@@ -45,6 +45,32 @@ def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
             "reference's batch/replica divisibility check "
             "(distributed_train.py:154-158)."
         )
+    if devices and devices[0].platform == "tpu":
+        # Topology-aware placement: on real TPU slices the physical ICI
+        # graph is a torus, and a naive row-major reshape can put a
+        # heavy-collective axis (model all-reduce, seq/pipe ring) across
+        # non-adjacent chips. mesh_utils maps logical axes onto physical
+        # torus axes (deterministic for a given topology, so every host in
+        # a pod computes the same assignment). CPU/GPU fall through to the
+        # plain reshape — there is no torus to exploit.
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(
+                cfg.axis_sizes, devices=devices, allow_split_physical_axes=True
+            )
+            return Mesh(arr, cfg.axis_names)
+        except Exception as e:  # unusual topology: the reshape below is valid
+            import warnings
+
+            warnings.warn(
+                "topology-aware mesh placement unavailable "
+                f"({type(e).__name__}: {e}); falling back to row-major "
+                "device order — heavy-collective axes may land on "
+                "non-adjacent chips",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     arr = np.asarray(devices).reshape(cfg.axis_sizes)
     return Mesh(arr, cfg.axis_names)
 
