@@ -157,13 +157,12 @@ def bench_config(
 
         def scan_steps(state, src, tgt, rng):
             def body(s, _):
-                s, m = inner(s, src, tgt, rng)
-                return s, None
+                return inner(s, src, tgt, rng)
 
-            state, _ = jax.lax.scan(body, state, None, length=n_steps)
-            # One per-scan metrics read keeps the VALUE-fetch sync contract.
-            state, metrics = inner(state, src, tgt, rng)
-            return state, metrics
+            state, ms = jax.lax.scan(body, state, None, length=n_steps)
+            # The last step's metrics are a scan output: fetching them still
+            # blocks on the whole device loop (VALUE-fetch sync contract).
+            return state, jax.tree.map(lambda x: x[-1], ms)
 
         step = jax.jit(scan_steps, donate_argnums=(0,) if donate else ())
     else:
@@ -174,7 +173,8 @@ def bench_config(
     if not donate:
         print(f"{name}: tied weights, benchmarking undonated", file=sys.stderr)
 
-    for _ in range(2 if mode == "deviceloop" else 3):  # compile + settle
+    warmups = 2 if mode == "deviceloop" else 3  # compile + settle
+    for _ in range(warmups):
         state, metrics = step(state, src, tgt, rng)
     # Synchronize via a VALUE fetch, not block_until_ready: on tunneled/
     # remote PJRT backends block_until_ready can return before device
@@ -189,12 +189,10 @@ def bench_config(
     with ctx:
         t0 = time.perf_counter()
         if mode == "deviceloop":
-            # ONE dispatch covering n_steps+1 optimizer steps on device
-            # (n_steps in the scan + the metrics step); normalize to
-            # per-optimizer-step time.
+            # ONE dispatch covering all n_steps optimizer steps on device.
             state, metrics = step(state, src, tgt, rng)
             final_loss = float(metrics["loss"])
-            dt = (time.perf_counter() - t0) * (n_steps / (n_steps + 1.0))
+            dt = time.perf_counter() - t0
         else:
             for _ in range(n_steps):
                 state, metrics = step(state, src, tgt, rng)

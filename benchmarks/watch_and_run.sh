@@ -28,11 +28,18 @@ EXTRA=bench_extras.jsonl
 ERR=bench_run.err
 log() { echo "$(date +%F_%T) $*" >>"$LOG"; }
 
+# Required measurements stop being retried after this many recorded
+# failures, so one persistently broken config cannot keep the watchdog
+# alive (and re-burning 2400s timeouts) forever.
+MAX_ERRORS=3
+
 missing_rows() {
   local out="" c
   for c in big tied long4k; do
     grep -q "\"metric\": \"$c train throughput\", \"value\"" "$ROWS" 2>/dev/null \
-      || out="$out,$c"
+      && continue
+    [ "$(error_count "$c train throughput" "$ROWS")" -ge "$MAX_ERRORS" ] && continue
+    out="$out,$c"
   done
   echo "${out#,}"
 }
@@ -43,7 +50,9 @@ missing_attr() {
   local out="" m
   for m in fwd smallvocab; do
     grep -q "\"metric\": \"base train throughput \\[$m\\]\", \"value\"" "$ATTR" 2>/dev/null \
-      || out="$out,$m"
+      && continue
+    [ "$(error_count "base train throughput [$m]" "$ATTR")" -ge "$MAX_ERRORS" ] && continue
+    out="$out,$m"
   done
   echo "${out#,}"
 }
