@@ -57,30 +57,36 @@ def main() -> None:
         batch_size=16, sequence_length=8, warmup_steps=10,
         loss_normalization="tokens",
     )
-    mesh = make_mesh(MeshConfig(data=4, fsdp=2))
-    state, shardings = create_sharded_state(
-        jax.random.PRNGKey(0), model_cfg, train_cfg, mesh
-    )
-    train_step, _ = make_sharded_steps(
-        mesh, model_cfg, train_cfg, shardings, donate=False
-    )
-
     rng = jax.random.PRNGKey(42)
-    losses = []
-    for i in range(3):
-        # Same GLOBAL batch on both processes; each feeds only its row shard
-        # (the multi-host data contract, Seq2SeqDataset.shard_index).
-        ks, kt = jax.random.split(jax.random.PRNGKey(100 + i))
-        src = np.asarray(jax.random.randint(ks, (16, 8), 1, 32), np.int32)
-        tgt = np.asarray(jax.random.randint(kt, (16, 8), 1, 32), np.int32)
-        lo, hi = pid * 8, (pid + 1) * 8
-        state, m = train_step(
-            state,
-            put_batch(src[lo:hi], mesh),
-            put_batch(tgt[lo:hi], mesh),
-            rng,
+
+    def run_steps(mesh_cfg: MeshConfig) -> tuple:
+        """Three sharded optimizer steps on a fresh mesh/state; the batches
+        are identical by construction across calls (and processes): same
+        GLOBAL batch everywhere, each process feeding only its row shard
+        (the multi-host data contract, Seq2SeqDataset.shard_index)."""
+        mesh = make_mesh(mesh_cfg)
+        state, shardings = create_sharded_state(
+            jax.random.PRNGKey(0), model_cfg, train_cfg, mesh
         )
-        losses.append(float(m["loss"]))
+        step, _ = make_sharded_steps(
+            mesh, model_cfg, train_cfg, shardings, donate=False
+        )
+        losses = []
+        for i in range(3):
+            ks, kt = jax.random.split(jax.random.PRNGKey(100 + i))
+            src = np.asarray(jax.random.randint(ks, (16, 8), 1, 32), np.int32)
+            tgt = np.asarray(jax.random.randint(kt, (16, 8), 1, 32), np.int32)
+            lo, hi = pid * 8, (pid + 1) * 8
+            state, m = step(
+                state,
+                put_batch(src[lo:hi], mesh),
+                put_batch(tgt[lo:hi], mesh),
+                rng,
+            )
+            losses.append(float(m["loss"]))
+        return losses, state
+
+    losses, state = run_steps(MeshConfig(data=4, fsdp=2))
 
     # Multi-process sharded checkpoint: every process writes its addressable
     # shards; device-backed barriers order clear -> write -> rename.
@@ -89,11 +95,18 @@ def main() -> None:
     restored = mgr.restore(state, step=3)
     checksum = tree_checksum(jax.device_get(restored.params))
 
+    # Hybrid multi-slice mesh (MeshConfig.dcn_data): the data axis spans the
+    # two processes as DCN granules (process_is_granule off-TPU), fsdp stays
+    # intra-process — the "data over DCN, everything else over ICI" layout.
+    # Numerics must match the flat-mesh run on the same batches.
+    hlosses, _ = run_steps(MeshConfig(data=4, fsdp=2, dcn_data=2))
+
     print(
         json.dumps(
             {
                 "pid": pid,
                 "losses": [round(l, 6) for l in losses],
+                "hybrid_losses": [round(l, 6) for l in hlosses],
                 "restore_checksum": checksum,
                 "n_processes": jax.process_count(),
                 "n_devices": len(jax.devices()),

@@ -72,6 +72,16 @@ class TestMesh:
             "data": 2, "fsdp": 2, "model": 2, "seq": 1, "pipe": 1, "expert": 1
         }
 
+    def test_hybrid_dcn_validation(self):
+        """Multi-slice meshes (MeshConfig.dcn_data): divisibility and
+        granule-count failures must be loud. (The success path needs real
+        multi-granule devices: exercised by tests/test_multiprocess.py.)"""
+        with pytest.raises(ValueError, match="dcn_data"):
+            make_mesh(MeshConfig(data=4, fsdp=2, dcn_data=3))
+        with pytest.raises(ValueError, match="granule"):
+            # Single-process CPU = one granule; a 2-slice mesh can't build.
+            make_mesh(MeshConfig(data=4, fsdp=2, dcn_data=2))
+
     def test_device_count_mismatch_raises(self):
         with pytest.raises(ValueError):
             make_mesh(MeshConfig(data=3))

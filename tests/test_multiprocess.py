@@ -73,6 +73,13 @@ def test_two_process_training_matches_single(tmp_path):
     assert outs[0]["losses"] == outs[1]["losses"]
     # Both restored identical params from the shared sharded checkpoint.
     assert outs[0]["restore_checksum"] == outs[1]["restore_checksum"]
+    # The hybrid multi-slice mesh (data over process-granule "DCN", fsdp
+    # intra-process) reproduces the flat-mesh numerics on the same batches
+    # (device arrangement must not change the math, only the transport).
+    assert outs[0]["hybrid_losses"] == outs[1]["hybrid_losses"]
+    np.testing.assert_allclose(
+        outs[0]["hybrid_losses"], outs[0]["losses"], atol=2e-5
+    )
 
     # The 2-process run must match the single-process 8-device oracle.
     import jax

@@ -172,6 +172,12 @@ def define_flags() -> None:
         "GPipe microbatches per step (0 = one per stage); more microbatches "
         "shrink the pipeline bubble at the cost of smaller per-shard matmuls")
     flags.DEFINE_integer(
+        "dcn_data", 1,
+        "multi-slice: how many DCN-connected slices (processes off-TPU) the "
+        "data axis spans; must divide --dp. Slow DCN hops then carry only "
+        "the data-parallel gradient all-reduce — every other axis stays on "
+        "intra-slice ICI.")
+    flags.DEFINE_integer(
         "eval_max_batches", 8,
         "cap on in-loop eval batches (0 = full test set each eval)")
     flags.DEFINE_integer(
@@ -272,7 +278,7 @@ def flags_to_mesh_config(n_devices: int) -> MeshConfig:
     dp = FLAGS.dp or max(1, n_devices // non_dp)
     return MeshConfig(
         data=dp, fsdp=FLAGS.fsdp, model=FLAGS.tp, seq=FLAGS.sp, pipe=FLAGS.pp,
-        expert=FLAGS.ep,
+        expert=FLAGS.ep, dcn_data=FLAGS.dcn_data,
     )
 
 

@@ -265,6 +265,13 @@ class MeshConfig:
     seq: int = 1
     pipe: int = 1
     expert: int = 1
+    # Multi-slice: how many DCN-connected slices (or processes, off-TPU) the
+    # DATA axis spans. Must divide ``data``. The mesh is then built hybrid
+    # (jax mesh_utils): the slow inter-slice DCN hops carry only the
+    # data-parallel gradient all-reduce, while fsdp/model/seq/pipe/expert
+    # collectives stay on intra-slice ICI — the "collectives ride ICI, not
+    # DCN" layout. 1 = single slice (plain mesh).
+    dcn_data: int = 1
 
     @property
     def num_devices(self) -> int:

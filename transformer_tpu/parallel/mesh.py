@@ -45,6 +45,8 @@ def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
             "reference's batch/replica divisibility check "
             "(distributed_train.py:154-158)."
         )
+    if cfg.dcn_data > 1:
+        return _hybrid_mesh(cfg, devices)
     if devices and devices[0].platform == "tpu":
         # Topology-aware placement: on real TPU slices the physical ICI
         # graph is a torus, and a naive row-major reshape can put a
@@ -72,6 +74,48 @@ def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
                 stacklevel=2,
             )
     arr = np.asarray(devices).reshape(cfg.axis_sizes)
+    return Mesh(arr, cfg.axis_names)
+
+
+def _hybrid_mesh(cfg: MeshConfig, devices: list) -> Mesh:
+    """Multi-slice mesh: the data axis spans ``cfg.dcn_data`` DCN-connected
+    granules (TPU slices, or processes off-TPU), every other axis stays
+    inside one granule. Slow DCN hops then carry only the data-parallel
+    gradient all-reduce; fsdp gathers, tensor-parallel all-reduces, and the
+    seq/pipe rings all ride intra-slice ICI (the reference's single-host
+    NCCL topology has no counterpart — SURVEY §2.4 multi-host).
+    """
+    from jax.experimental import mesh_utils
+
+    if cfg.data % cfg.dcn_data:
+        raise ValueError(
+            f"dcn_data={cfg.dcn_data} must divide the data axis ({cfg.data}): "
+            "the data axis is the only one spanning DCN"
+        )
+    per_slice = (cfg.data // cfg.dcn_data, *cfg.axis_sizes[1:])
+    dcn = (cfg.dcn_data, 1, 1, 1, 1, 1)
+    # Granule choice: TPU multi-slice runs distinguish devices by
+    # slice_index; everywhere else (CPU/GPU fleets — and single-slice
+    # backends, where slice_index exists but is 0 on every device) the
+    # process is the DCN granule. Decide by whichever attribute actually
+    # distinguishes more than one granule.
+    slice_vals = {getattr(d, "slice_index", None) for d in devices}
+    try:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices,
+            process_is_granule=len(slice_vals) <= 1,
+            allow_split_physical_axes=True,  # parity with the flat TPU path
+        )
+    except ValueError as e:
+        hint = (
+            " Hint: dcn_data must equal the number of DCN granules (TPU "
+            "slices, or processes off-TPU) the devices span."
+            if "granule" in str(e) or "slices" in str(e).lower()
+            else ""
+        )
+        raise ValueError(
+            f"hybrid mesh {per_slice} x dcn {dcn} failed: {e}.{hint}"
+        ) from e
     return Mesh(arr, cfg.axis_names)
 
 
