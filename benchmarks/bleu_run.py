@@ -3,7 +3,7 @@
 The BASELINE.json north star is "eval BLEU on src/tgt" — this script is the
 committed reproduction command behind the BLEU number in BASELINE.md:
 
-    python benchmarks/bleu_run.py [--config base|tiny] [--epochs N]
+    python benchmarks/bleu_run.py [--config base|small|tiny] [--epochs N]
 
 Trains on data/src-train.txt → tgt-train.txt (10k pairs, the corpus the
 reference bundles), greedy-decodes the bundled 500-pair test split, and
@@ -40,7 +40,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default="base", choices=["tiny", "base"])
+    ap.add_argument(
+        "--config", default="base", choices=["tiny", "small", "base"],
+        help="tiny/small are CPU-fallback scales; base is the headline "
+        "Transformer-base run",
+    )
     ap.add_argument("--epochs", type=int, default=40)
     ap.add_argument("--warmup", type=int, default=2000)
     ap.add_argument("--seq_len", type=int, default=50)
@@ -142,6 +146,7 @@ def main() -> None:
         )
     shapes = {
         "tiny": dict(num_layers=2, d_model=128, num_heads=4, dff=512),
+        "small": dict(num_layers=2, d_model=256, num_heads=8, dff=1024),
         "base": dict(num_layers=6, d_model=512, num_heads=8, dff=2048),
     }[args.config]
     model_cfg = ModelConfig(
