@@ -61,6 +61,7 @@ class TestProfiler:
             dumped.extend(os.path.join(root, f) for f in files)
         assert dumped, "profiler produced no trace files"
 
+    @pytest.mark.slow
     def test_trainer_integration(self, tmp_path):
         prof = Profiler(str(tmp_path / "prof"), start_step=1, num_steps=2)
         state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)

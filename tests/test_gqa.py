@@ -88,6 +88,7 @@ class TestGqaModel:
                 atol=2e-4,
             )
 
+    @pytest.mark.slow
     def test_seq2seq_gqa_trains_and_translates(self):
         from transformer_tpu.train import create_train_state, make_train_step
         from transformer_tpu.train.decode import greedy_decode

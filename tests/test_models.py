@@ -215,6 +215,7 @@ class TestTransformer:
             np.asarray(l1[:, :3]), np.asarray(l2[:, :3]), atol=1e-6
         )
 
+    @pytest.mark.slow
     def test_gradients_flow_everywhere(self):
         params = transformer_init(jax.random.PRNGKey(0), TINY)
         inp = tokens(jax.random.PRNGKey(1), 40, (2, 5))

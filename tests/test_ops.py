@@ -208,6 +208,7 @@ class TestFFN:
         expected = h @ np.asarray(params["out"]["kernel"]) + np.asarray(params["out"]["bias"])
         np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
 
+    @pytest.mark.slow
     def test_swiglu_model_trains(self):
         from transformer_tpu.config import ModelConfig, TrainConfig
         from transformer_tpu.train import create_train_state, make_train_step

@@ -13,6 +13,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from transformer_tpu.config import ModelConfig, TrainConfig
 from transformer_tpu.ops.positional import apply_rope
@@ -125,6 +126,7 @@ class TestRopeModel:
             first = float(m["loss"]) if first is None else first
         assert float(m["loss"]) < first * 0.7
 
+    @pytest.mark.slow
     def test_seq2seq_rope_trains(self):
         """Encoder-decoder with RoPE: encoder self-attn and decoder self-attn
         rotate; cross-attention does not."""
