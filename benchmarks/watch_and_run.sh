@@ -186,7 +186,8 @@ while :; do
     # the final {"bleu": ...} line lands.
     log "running BLEU convergence pass (8-epoch budget, resumable)"
     timeout 3600 python benchmarks/bleu_run.py --config base --epochs 40 \
-      --bleu_every 10 --epoch_budget 8 >>"$BLEU" 2>>bleu_run.err
+      --bleu_every 10 --epoch_budget 8 --label_smoothing 0.1 \
+      >>"$BLEU" 2>>bleu_run.err
     rc=$?
     [ "$rc" -ne 0 ] && record_failure "base BLEU run" "$BLEU" "$rc"
     log "BLEU pass done (rc=$rc)"
