@@ -1,0 +1,31 @@
+#!/bin/bash
+# One healthy-window capture sequence: quick atomic rows first, then hand
+# off to the watchdog (BLEU passes + extras). Run from repo root.
+cd "$(dirname "$0")/.." || exit 1
+trap 'rm -f .tpu_busy' EXIT
+log() { echo "$(date +%F_%T) $*" >>watch_tpu.log; }
+log "capture_window: starting (rows+attr first, then watchdog)"
+for c in big tied long4k; do
+  grep -q "\"metric\": \"$c train throughput\", \"value\"" bench_rows.jsonl 2>/dev/null && continue
+  ss -tln | grep -q ':8082 ' || { log "relay down before $c; aborting to watchdog"; break; }
+  touch .tpu_busy
+  log "row: $c"
+  timeout 2400 python benchmarks/run.py --configs "$c" >>bench_rows.jsonl 2>>bench_run.err
+  rc=$?
+  [ "$rc" -ne 0 ] && echo "{\"metric\": \"$c train throughput\", \"error\": \"capture: rc=$rc\"}" >>bench_rows.jsonl
+  log "row $c done rc=$rc"
+  rm -f .tpu_busy
+done
+for m in fwd smallvocab; do
+  grep -q "\"metric\": \"base train throughput \\[$m\\]\", \"value\"" bench_attr.jsonl 2>/dev/null && continue
+  ss -tln | grep -q ':8082 ' || break
+  touch .tpu_busy
+  log "attr: $m"
+  timeout 2400 python benchmarks/run.py --configs base --modes "$m" >>bench_attr.jsonl 2>>bench_run.err
+  rc=$?
+  [ "$rc" -ne 0 ] && echo "{\"metric\": \"base train throughput [$m]\", \"error\": \"capture: rc=$rc\"}" >>bench_attr.jsonl
+  log "attr $m done rc=$rc"
+  rm -f .tpu_busy
+done
+log "capture_window: handing off to watchdog"
+exec bash benchmarks/watch_and_run.sh
