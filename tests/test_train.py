@@ -154,6 +154,181 @@ class _FixedBatches:
             yield self.src, self.tgt
 
 
+class _VariedBatches:
+    """Dataset stub with per-step-DISTINCT batches (so trajectory parity is
+    meaningful) and an optional narrower final batch (so the multi-step
+    grouper's shape-change flush is exercised)."""
+
+    def __init__(self, n=7, seed=0, narrow_last=False):
+        self.n = n
+        self.seed = seed
+        self.narrow_last = narrow_last
+
+    def __len__(self):
+        return self.n
+
+    def batches(self, epoch=0):
+        for i in range(self.n):
+            k = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch * 1000 + i)
+            k1, k2 = jax.random.split(k)
+            w = 6 if (self.narrow_last and i == self.n - 1) else 8
+            yield (
+                np.asarray(jax.random.randint(k1, (4, w), 1, 30)),
+                np.asarray(jax.random.randint(k2, (4, w), 1, 30)),
+            )
+
+
+class TestMultistepDispatch:
+    def test_scan_matches_sequential(self):
+        """K optimizer steps inside one jitted scan (steps_per_dispatch)
+        must reproduce K separate dispatches: same params, same metric sums
+        (pre-reduced on device)."""
+        from transformer_tpu.train.trainer import make_multistep_train_step
+
+        K = 4
+        rng = jax.random.PRNGKey(3)
+        srcs = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (K, 4, 8), 1, 30)
+        )
+        tgts = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(2), (K, 4, 8), 1, 30)
+        )
+        step = make_train_step(TINY, TCFG)
+
+        s_ref = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        jstep = jax.jit(step)
+        sums = {"loss_sum": 0.0, "weight": 0.0, "correct": 0.0}
+        for i in range(K):
+            s_ref, m = jstep(s_ref, srcs[i], tgts[i], rng)
+            for k in sums:
+                sums[k] += float(m[k])
+
+        s_multi = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        multi = jax.jit(make_multistep_train_step(step))
+        s_multi, mm = multi(s_multi, srcs, tgts, rng)
+
+        assert int(s_multi.step) == K
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            s_ref.params, s_multi.params,
+        )
+        for k in sums:
+            np.testing.assert_allclose(float(mm[k]), sums[k], rtol=1e-5, err_msg=k)
+        np.testing.assert_allclose(
+            float(mm["loss"]), sums["loss_sum"] / max(sums["weight"], 1.0),
+            rtol=1e-5,
+        )
+
+    def test_trainer_trajectory_parity(self):
+        """A full Trainer.fit with steps_per_dispatch=3 over 7 varied batches
+        (groups 3+3+1, final batch a different width → shape-change flush)
+        must land on the same params and epoch metrics as the plain loop."""
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        def run(spd):
+            tc = dataclasses.replace(
+                TCFG, epochs=2, warmup_steps=10, steps_per_dispatch=spd,
+                eval_every_steps=0, log_every_steps=0,
+            )
+            state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+            tr = Trainer(TINY, tc, state, log_fn=lambda s: None)
+            tr.fit(_VariedBatches(n=7, seed=5, narrow_last=True))
+            return tr
+
+        ref, multi = run(1), run(3)
+        assert int(multi.state.step) == 14
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            ref.state.params, multi.state.params,
+        )
+        np.testing.assert_allclose(
+            multi.train_metrics.loss, ref.train_metrics.loss, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            multi.train_metrics.accuracy, ref.train_metrics.accuracy, rtol=1e-5
+        )
+
+    def test_log_eval_boundary_crossing(self):
+        """A K-step dispatch that jumps OVER a log/eval boundary must still
+        trigger the log/eval (boundary-crossing check, not step % N == 0)."""
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        tc = dataclasses.replace(
+            TCFG, epochs=1, warmup_steps=10, steps_per_dispatch=3,
+            log_every_steps=5, eval_every_steps=5, eval_max_batches=1,
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        logs = []
+        tr = Trainer(TINY, tc, state, log_fn=logs.append)
+        # 6 identical-shape batches -> dispatches end at steps 3 and 6;
+        # step 5 is never hit exactly, but 3->6 crosses it.
+        tr.fit(_FixedBatches(n=6, seed=0), _FixedBatches(n=1, seed=7))
+        assert any("step 6 " in l for l in logs), logs
+        assert any("eval loss" in l for l in logs), logs
+
+    def test_rejects_bad_config(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="steps_per_dispatch"):
+            dataclasses.replace(TCFG, steps_per_dispatch=0)
+
+    def test_rejects_eager_mode(self):
+        import dataclasses
+
+        from transformer_tpu.train import Trainer
+
+        tc = dataclasses.replace(
+            TCFG, steps_per_dispatch=2, enable_function=False
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        tr = Trainer(TINY, tc, state, log_fn=lambda s: None)
+        # The guard fires at fit() time, where only the plain eager Trainer
+        # lacks a scanned step (DistributedTrainer always jits its own).
+        with pytest.raises(ValueError, match="enable_function"):
+            tr.fit(_FixedBatches(n=2, seed=0))
+
+    def test_batch_normalization_loss_metric(self):
+        """Under loss_normalization='batch' the per-dispatch 'loss' must be
+        the mean of the K per-step batch-normalized losses, not the
+        token-normalized ratio."""
+        import dataclasses
+
+        from transformer_tpu.train.trainer import make_multistep_train_step
+
+        cfg = dataclasses.replace(TCFG, loss_normalization="batch")
+        K = 3
+        srcs = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (K, 4, 8), 1, 30)
+        )
+        tgts = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(2), (K, 4, 8), 1, 30)
+        )
+        rng = jax.random.PRNGKey(3)
+        step = make_train_step(TINY, cfg)
+
+        s_ref = create_train_state(jax.random.PRNGKey(0), TINY, cfg)
+        jstep = jax.jit(step)
+        per_step = []
+        for i in range(K):
+            s_ref, m = jstep(s_ref, srcs[i], tgts[i], rng)
+            per_step.append(float(m["loss"]))
+
+        s_multi = create_train_state(jax.random.PRNGKey(0), TINY, cfg)
+        multi = jax.jit(
+            make_multistep_train_step(
+                step, loss_normalization="batch", batch_size=cfg.batch_size
+            )
+        )
+        _, mm = multi(s_multi, srcs, tgts, rng)
+        np.testing.assert_allclose(
+            float(mm["loss"]), np.mean(per_step), rtol=1e-5
+        )
+
+
 class TestEarlyStopping:
     def test_stops_when_eval_plateaus(self):
         """Overfitting a fixed batch while evaluating on a DIFFERENT fixed

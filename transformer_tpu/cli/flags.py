@@ -192,6 +192,11 @@ def define_flags() -> None:
         "compute the vocab projection + CE over this many sequence slices so "
         "the full (B,S,V) logits tensor is never materialized (1 = off) — "
         "the memory lever for big-vocab/long-context configs")
+    flags.DEFINE_integer(
+        "steps_per_dispatch", 1,
+        "optimizer steps per host dispatch, run inside one jitted lax.scan "
+        "(1 = off) — amortizes per-step dispatch overhead when step times "
+        "are small; log/eval/preemption granularity becomes this many steps")
     flags.DEFINE_boolean(
         "async_checkpoint", False,
         "write checkpoints from a background thread (device snapshot stays "
@@ -257,6 +262,7 @@ def flags_to_train_config() -> TrainConfig:
         early_stop_patience=FLAGS.early_stop_patience,
         grad_accum_steps=FLAGS.grad_accum,
         loss_chunks=FLAGS.loss_chunks,
+        steps_per_dispatch=FLAGS.steps_per_dispatch,
     )
 
 

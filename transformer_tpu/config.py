@@ -215,6 +215,15 @@ class TrainConfig:
     # the full (B, S, V) logits tensor is never materialized — the memory
     # lever for big-vocab/long-context configs. 1 = off.
     loss_chunks: int = 1
+    # Host-dispatch amortization: run this many optimizer steps inside ONE
+    # jitted lax.scan per host→device dispatch (trainer.py
+    # make_multistep_train_step). At small step times the per-step Python/
+    # runtime dispatch is a measurable share of wall clock (BASELINE.md
+    # [deviceloop] probe); K steps per dispatch divide it by K. Orthogonal
+    # to grad_accum_steps (each inner step is still a full optimizer
+    # update). Trade-off: preemption/log/eval granularity becomes K steps.
+    # 1 = off.
+    steps_per_dispatch: int = 1
 
     def __post_init__(self) -> None:
         if self.loss_normalization not in ("tokens", "batch"):
@@ -237,6 +246,10 @@ class TrainConfig:
             raise ValueError(
                 "lr_schedule='cosine' needs lr_decay_steps > warmup_steps "
                 f"(got {self.lr_decay_steps} <= {self.warmup_steps})"
+            )
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {self.steps_per_dispatch}"
             )
 
 

@@ -20,6 +20,7 @@ from transformer_tpu.parallel.sharding import (
 from transformer_tpu.parallel.distributed import (
     DistributedTrainer,
     create_sharded_state,
+    make_sharded_multistep,
     make_sharded_steps,
     put_batch,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "batch_spec",
     "create_sharded_state",
     "make_mesh",
+    "make_sharded_multistep",
     "make_sharded_steps",
     "param_partition_spec",
     "put_batch",

@@ -156,18 +156,14 @@ def _expert_parallel_forward(
     return forward
 
 
-def make_sharded_steps(
+def _raw_sharded_steps(
     mesh: Mesh,
     model_cfg: ModelConfig,
     train_cfg: TrainConfig,
-    shardings: Any,
-    shard_seq: bool = False,
-    donate: bool = True,
 ) -> tuple[Callable, Callable]:
-    """jit the train/eval steps with explicit in/out shardings over ``mesh``.
-
-    A mesh with ``pipe > 1`` swaps in the GPipe-pipelined forward; all other
-    axes keep the plain SPMD-sharded step."""
+    """Validation + the mesh-aware forward chain, returning the UNJITTED
+    train/eval step functions — shared by :func:`make_sharded_steps` (plain
+    jit-with-shardings) and :func:`make_sharded_multistep` (K-step scan)."""
     if (
         model_cfg.moe_experts
         and model_cfg.moe_every > 1
@@ -191,13 +187,6 @@ def make_sharded_steps(
             f"moe_experts {model_cfg.moe_experts} must be divisible by the "
             f"expert mesh axis ({ep}) for expert weights to shard"
         )
-    data_sh = NamedSharding(mesh, batch_spec(mesh, shard_seq))
-    repl = NamedSharding(mesh, P())
-    metrics_sh = {
-        "loss": repl, "loss_sum": repl, "weight": repl, "correct": repl
-    }
-    if model_cfg.moe_experts:
-        metrics_sh["moe_aux"] = repl
     def build_forward(hidden: bool) -> Callable | None:
         fn = (
             _pipelined_forward(mesh, model_cfg, train_cfg, hidden=hidden)
@@ -220,24 +209,88 @@ def make_sharded_steps(
     hidden_forward_fn = (
         build_forward(hidden=True) if train_cfg.loss_chunks > 1 else None
     )
-    train_step = jax.jit(
+    return (
         make_train_step(
             model_cfg, train_cfg, forward_fn=forward_fn,
             hidden_forward_fn=hidden_forward_fn,
         ),
+        make_eval_step(
+            model_cfg, train_cfg, forward_fn=forward_fn,
+            hidden_forward_fn=hidden_forward_fn,
+        ),
+    )
+
+
+def _metric_shardings(mesh: Mesh, model_cfg: ModelConfig) -> dict:
+    repl = NamedSharding(mesh, P())
+    metrics_sh = {
+        "loss": repl, "loss_sum": repl, "weight": repl, "correct": repl
+    }
+    if model_cfg.moe_experts:
+        metrics_sh["moe_aux"] = repl
+    return metrics_sh
+
+
+def make_sharded_steps(
+    mesh: Mesh,
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    shardings: Any,
+    shard_seq: bool = False,
+    donate: bool = True,
+) -> tuple[Callable, Callable]:
+    """jit the train/eval steps with explicit in/out shardings over ``mesh``.
+
+    A mesh with ``pipe > 1`` swaps in the GPipe-pipelined forward; all other
+    axes keep the plain SPMD-sharded step."""
+    raw_train, raw_eval = _raw_sharded_steps(mesh, model_cfg, train_cfg)
+    data_sh = NamedSharding(mesh, batch_spec(mesh, shard_seq))
+    repl = NamedSharding(mesh, P())
+    metrics_sh = _metric_shardings(mesh, model_cfg)
+    train_step = jax.jit(
+        raw_train,
         in_shardings=(shardings, data_sh, data_sh, repl),
         out_shardings=(shardings, metrics_sh),
         donate_argnums=(0,) if donate else (),
     )
     eval_step = jax.jit(
-        make_eval_step(
-            model_cfg, train_cfg, forward_fn=forward_fn,
-            hidden_forward_fn=hidden_forward_fn,
-        ),
+        raw_eval,
         in_shardings=(shardings, data_sh, data_sh),
         out_shardings=metrics_sh,
     )
     return train_step, eval_step
+
+
+def make_sharded_multistep(
+    mesh: Mesh,
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    shardings: Any,
+    shard_seq: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """``steps_per_dispatch`` over a mesh: the same wrapped forward chain as
+    :func:`make_sharded_steps`, but K optimizer steps run inside one jitted
+    ``lax.scan`` per dispatch (``trainer.make_multistep_train_step``).
+    Batches arrive stacked (K, B, S); the leading (scan) axis is unsharded,
+    each inner step's batch keeps the normal data/seq sharding."""
+    from transformer_tpu.train.trainer import make_multistep_train_step
+
+    raw_train, _ = _raw_sharded_steps(mesh, model_cfg, train_cfg)
+    stacked_sh = NamedSharding(mesh, P(None, *batch_spec(mesh, shard_seq)))
+    repl = NamedSharding(mesh, P())
+    metrics_sh = _metric_shardings(mesh, model_cfg)
+    return jax.jit(
+        make_multistep_train_step(
+            raw_train,
+            has_moe=bool(model_cfg.moe_experts),
+            loss_normalization=train_cfg.loss_normalization,
+            batch_size=train_cfg.batch_size,
+        ),
+        in_shardings=(shardings, stacked_sh, stacked_sh, repl),
+        out_shardings=(shardings, metrics_sh),
+        donate_argnums=(0,) if donate else (),
+    )
 
 
 def put_batch(batch: np.ndarray, mesh: Mesh, shard_seq: bool = False) -> jax.Array:
@@ -250,6 +303,7 @@ def put_batch(batch: np.ndarray, mesh: Mesh, shard_seq: bool = False) -> jax.Arr
     the role the reference's ``strategy.make_dataset_iterator`` played
     (``distributed_train.py:151-152``), without a per-replica iterator protocol.
     """
+    stacked = batch.ndim == 3  # (K, B, S): steps_per_dispatch groups
     if shard_seq:
         # Sequence sharding needs S divisible by the seq axis; trailing PAD
         # columns are inert (masked out of attention and loss) and the
@@ -257,10 +311,14 @@ def put_batch(batch: np.ndarray, mesh: Mesh, shard_seq: bool = False) -> jax.Arr
         from transformer_tpu.config import PAD_ID
 
         sp = mesh.shape["seq"]
-        extra = (-batch.shape[1]) % sp
+        extra = (-batch.shape[-1]) % sp
         if extra:
-            batch = np.pad(batch, ((0, 0), (0, extra)), constant_values=PAD_ID)
-    sharding = NamedSharding(mesh, batch_spec(mesh, shard_seq))
+            pad = [(0, 0)] * (batch.ndim - 1) + [(0, extra)]
+            batch = np.pad(batch, pad, constant_values=PAD_ID)
+    spec = batch_spec(mesh, shard_seq)
+    if stacked:
+        spec = P(None, *spec)  # scan axis unsharded
+    sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     return jax.make_array_from_process_local_data(sharding, batch)
@@ -343,17 +401,35 @@ class DistributedTrainer(Trainer):
         self.shardings = shardings
         super().__init__(model_cfg, train_cfg, state, **kwargs)
         # Replace the plain-jit steps built by Trainer.__init__ with the
-        # sharded versions (always jitted: eager SPMD doesn't exist).
+        # sharded versions (always jitted: eager SPMD doesn't exist),
+        # honouring the caller's donate_state choice (tied-weight configs
+        # must not donate: one buffer aliased into two consumers fails at
+        # TPU execution time).
+        donate = kwargs.get("donate_state", True)
         self.train_step_fn, self.eval_step_fn = make_sharded_steps(
-            mesh, model_cfg, train_cfg, shardings, shard_seq
+            mesh, model_cfg, train_cfg, shardings, shard_seq, donate=donate
         )
         self.train_step = self._sharded_train_step
         self.eval_step = self._sharded_eval_step
+        if train_cfg.steps_per_dispatch > 1:
+            # Replace the PLAIN multi-step Trainer.__init__ built (it has no
+            # shardings) with the mesh-aware one: same forward chain, K-step
+            # scan, stacked batches sharded on their (B, S) axes only.
+            self.multi_step_fn = make_sharded_multistep(
+                mesh, model_cfg, train_cfg, shardings, shard_seq,
+                donate=donate,
+            )
+            self.multi_step = self._sharded_multi_step
 
     def _sharded_train_step(self, state, src, tgt, rng):
         src = put_batch(np.asarray(src), self.mesh, self.shard_seq)
         tgt = put_batch(np.asarray(tgt), self.mesh, self.shard_seq)
         return self.train_step_fn(state, src, tgt, rng)
+
+    def _sharded_multi_step(self, state, src, tgt, rng):
+        src = put_batch(np.asarray(src), self.mesh, self.shard_seq)
+        tgt = put_batch(np.asarray(tgt), self.mesh, self.shard_seq)
+        return self.multi_step_fn(state, src, tgt, rng)
 
     def _sharded_eval_step(self, state, src, tgt):
         src = put_batch(np.asarray(src), self.mesh, self.shard_seq)

@@ -210,6 +210,60 @@ def make_train_step(
     return accum_train_step if accum > 1 else train_step
 
 
+def make_multistep_train_step(
+    step_fn: Callable,
+    has_moe: bool = False,
+    loss_normalization: str = "tokens",
+    batch_size: int = 0,
+) -> Callable[[TrainState, jax.Array, jax.Array, jax.Array], tuple[TrainState, dict]]:
+    """Wrap a train step so K optimizer steps run inside ONE ``lax.scan``
+    per host dispatch (``TrainConfig.steps_per_dispatch``).
+
+    Input batches are stacked on a leading axis: ``src``/``tgt`` are
+    (K, B, S). Per-step dropout keys stay exactly what K sequential calls
+    would have used — ``step_fn`` folds ``state.step`` into ``rng`` and the
+    step counter advances inside the scan — so the trajectory matches K
+    separate dispatches to float tolerance (XLA compiles one fused scan
+    program, so low-order bits can differ; parity asserted at rtol≈1e-5 in
+    tests/test_train.py).
+
+    Metrics come back pre-reduced ON DEVICE over the K steps (sums for
+    ``loss_sum``/``weight``/``correct``; token-weighted mean for
+    ``moe_aux``), in the exact form ``MetricAccumulator.update`` expects —
+    no (K,)-shaped host transfer, async dispatch preserved.
+    """
+
+    def multistep(state: TrainState, src, tgt, rng):
+        def body(s, xs):
+            sb, tb = xs
+            s, m = step_fn(s, sb, tb, rng)
+            return s, m
+
+        state, ms = jax.lax.scan(body, state, (src, tgt))
+        k = ms["loss_sum"].shape[0]
+        out = {
+            "loss_sum": ms["loss_sum"].sum(0),
+            "weight": ms["weight"].sum(0),
+            "correct": ms["correct"].sum(0),
+        }
+        if loss_normalization == "batch" and batch_size:
+            # Match the single-step metric's normalization (reference rule,
+            # train.py:88): mean of the K per-step losses, each loss_sum/B.
+            out["loss"] = out["loss_sum"] / jnp.float32(batch_size * k)
+        else:
+            out["loss"] = out["loss_sum"] / jnp.maximum(out["weight"], 1.0)
+        if has_moe:
+            # update() re-multiplies moe_aux by weight; pre-dividing the
+            # weighted sum here keeps the epoch aggregate the same
+            # token-weighted mean K separate updates would produce.
+            out["moe_aux"] = (ms["moe_aux"] * ms["weight"]).sum(0) / jnp.maximum(
+                out["weight"], 1.0
+            )
+        return state, out
+
+    return multistep
+
+
 def _split_forward_out(out) -> tuple[jax.Array, jax.Array | None]:
     """Forward functions return logits, or (logits, moe_aux_loss) for MoE
     configs — normalize to a pair."""
@@ -383,6 +437,41 @@ class MetricAccumulator:
         return float(self._sums["moe_aux_w"]) / max(self.weight, 1.0)
 
 
+def _dispatch_groups(batches, k: int):
+    """Group consecutive SAME-SHAPE batches into stacks of up to ``k`` for
+    the multi-step dispatch path: yields ``(src, tgt, n)`` with src/tgt
+    stacked to (n, B, S) when n > 1, or the single batch unstacked when a
+    group has one member (shape change mid-group, epoch tail). Grouping
+    only ever joins identical shapes, so length-bucketed pipelines work —
+    each distinct (n, B, S) signature costs one jit re-trace, bounded by
+    #buckets × #tail-lengths per run."""
+    buf: list = []
+    sig = None
+    for b in batches:
+        s = (b[0].shape, b[1].shape)
+        if buf and s != sig:
+            yield _stack_group(buf)
+            buf = []
+        buf.append(b)
+        sig = s
+        if len(buf) == k:
+            yield _stack_group(buf)
+            buf = []
+    if buf:
+        yield _stack_group(buf)
+
+
+def _stack_group(buf: list):
+    if len(buf) == 1:
+        src, tgt = buf[0]
+        return src, tgt, 1
+    return (
+        np.stack([b[0] for b in buf]),
+        np.stack([b[1] for b in buf]),
+        len(buf),
+    )
+
+
 class Trainer:
     """Epoch-driven training loop.
 
@@ -421,7 +510,22 @@ class Trainer:
 
         train_step = make_train_step(model_cfg, train_cfg)
         eval_step = make_eval_step(model_cfg, train_cfg)
+        self.multi_step = None
         if train_cfg.enable_function:
+            if train_cfg.steps_per_dispatch > 1:
+                # K optimizer steps per host dispatch (one jitted scan):
+                # amortizes the per-step dispatch overhead the BASELINE.md
+                # [deviceloop] probe isolates. jit re-traces per distinct
+                # stacked shape (tail groups, length buckets) and caches.
+                self.multi_step = jax.jit(
+                    make_multistep_train_step(
+                        train_step,
+                        has_moe=bool(model_cfg.moe_experts),
+                        loss_normalization=train_cfg.loss_normalization,
+                        batch_size=train_cfg.batch_size,
+                    ),
+                    donate_argnums=(0,) if donate_state else (),
+                )
             # Donating the state buffers lets XLA update params in place —
             # halves peak HBM for the optimizer step.
             train_step = jax.jit(train_step, donate_argnums=(0,) if donate_state else ())
@@ -457,6 +561,16 @@ class Trainer:
         the hook for in-training quality tracking (e.g. periodic BLEU in
         ``benchmarks/bleu_run.py``)."""
         cfg = self.train_cfg
+        if cfg.steps_per_dispatch > 1 and self.multi_step is None:
+            # Plain Trainer in eager-debug mode: no scanned step was built
+            # (DistributedTrainer always jits and installs its own), so the
+            # feature would silently no-op — refuse instead.
+            raise ValueError(
+                "steps_per_dispatch > 1 requires enable_function=True on the "
+                "single-process Trainer: the multi-step dispatch is a jitted "
+                "lax.scan; in eager-debug mode it would silently fall back "
+                "to single-step dispatch"
+            )
         rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
         # Restore BEFORE training (fixes reference restore-after, train.py:242-243).
         if self.checkpoint is not None:
@@ -511,19 +625,39 @@ class Trainer:
                 self.train_metrics.reset()
                 self.step_timer.reset()
                 epoch_start = time.time()
-                for src, tgt in train_ds.batches(epoch):
+                batch_iter = train_ds.batches(epoch)
+                if self.multi_step is not None:
+                    groups = _dispatch_groups(batch_iter, cfg.steps_per_dispatch)
+                else:
+                    groups = ((s, t, 1) for s, t in batch_iter)
+                for src, tgt, k in groups:
                     if self.profiler is not None:
                         self.profiler.maybe_trace(step, block_on=self.state)
-                    self.state, m = self.train_step(self.state, src, tgt, rng)
+                    if k == 1:
+                        self.state, m = self.train_step(self.state, src, tgt, rng)
+                        # Actual target tokens this step (length-bucketed
+                        # batches are narrower than the nominal length).
+                        tokens = src.shape[0] * max(tgt.shape[1] - 1, 1)
+                    else:
+                        # K stacked same-shape batches, one dispatch, K
+                        # optimizer steps inside a jitted scan; metrics come
+                        # back pre-reduced over the group.
+                        self.state, m = self.multi_step(self.state, src, tgt, rng)
+                        tokens = k * src.shape[1] * max(tgt.shape[2] - 1, 1)
                     self.train_metrics.update(m)
-                    # Actual target tokens this step (length-bucketed batches
-                    # are narrower than the nominal sequence_length).
-                    self.step_timer.tick(src.shape[0] * max(tgt.shape[1] - 1, 1))
-                    step += 1
+                    self.step_timer.tick(tokens, steps=k)
+                    prev_step = step
+                    step += k
                     if guard.should_stop:
                         self._preempt(step, guard)
                         return
-                    if cfg.log_every_steps and step % cfg.log_every_steps == 0:
+                    # Boundary-crossing (not ==0) so a K-step dispatch that
+                    # jumps over a log/eval boundary still triggers it; for
+                    # k == 1 this is exactly the step % N == 0 cadence.
+                    if cfg.log_every_steps and (
+                        step // cfg.log_every_steps
+                        != prev_step // cfg.log_every_steps
+                    ):
                         loss = self.train_metrics.loss  # device_get: blocks
                         self.step_timer.sync()
                         aux = self.train_metrics.moe_aux
@@ -537,7 +671,8 @@ class Trainer:
                     if (
                         test_ds is not None
                         and cfg.eval_every_steps
-                        and step % cfg.eval_every_steps == 0
+                        and step // cfg.eval_every_steps
+                        != prev_step // cfg.eval_every_steps
                     ):
                         # Bounded in-loop eval (fixes reference full-test-set
                         # stall, train.py:193-195, and 1-batch quirk §2.3.3).

@@ -102,14 +102,19 @@ class StepTimer:
         self._total_tokens = 0
         self._total_time = 0.0
 
-    def tick(self, tokens: int | None = None) -> None:
-        """Call once per dispatched step. ``tokens`` overrides the fixed
-        ``tokens_per_step`` for that step — length-bucketed batches process
-        fewer tokens than the nominal batch×sequence_length."""
+    def tick(self, tokens: int | None = None, steps: int = 1) -> None:
+        """Call once per dispatch. ``tokens`` overrides the fixed
+        ``tokens_per_step`` for that dispatch — length-bucketed batches
+        process fewer tokens than the nominal batch×sequence_length.
+        ``steps`` > 1 when one dispatch covers several optimizer steps
+        (TrainConfig.steps_per_dispatch); ``tokens`` then counts the whole
+        group."""
         if self._window_start is None:
             self._window_start = time.perf_counter()
-        self._window_steps += 1
-        self._window_tokens += self.tokens_per_step if tokens is None else tokens
+        self._window_steps += steps
+        self._window_tokens += (
+            self.tokens_per_step * steps if tokens is None else tokens
+        )
 
     def sync(self) -> None:
         """Close the current window — call immediately after a blocking read
