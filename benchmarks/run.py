@@ -104,6 +104,12 @@ def bench_config(
                   suspect for the low measured MFU at batch 64 × seq 64
                   (BASELINE.md r2 analysis). Same math as `full`: the scan
                   carries the donated state through real optimizer steps.
+    - multistep:  the production dispatch-amortization path
+                  (TrainConfig.steps_per_dispatch / trainer.
+                  make_multistep_train_step): n_steps DISTINCT batches
+                  stacked into one (K,B,S) transfer, K optimizer steps per
+                  dispatch — what `--steps_per_dispatch K` buys a real
+                  training run (deviceloop is its upper bound).
 
     ``loss_chunks > 1`` additionally runs the chunked vocab-projection/CE
     path (TrainConfig.loss_chunks) for A/B against the monolithic loss.
@@ -140,8 +146,20 @@ def bench_config(
     rng = jax.random.PRNGKey(1)
     r = np.random.default_rng(0)
     top = min(32000, model_cfg.target_vocab_size - 2)
-    src = jax.device_put(r.integers(1, top, (batch, seq), dtype=np.int32))
-    tgt = jax.device_put(r.integers(1, top, (batch, seq), dtype=np.int32))
+    if mode == "multistep":
+        # The PRODUCTION dispatch-amortization path (TrainConfig.
+        # steps_per_dispatch): distinct stacked batches, one (K,B,S) host
+        # transfer, K real optimizer steps per dispatch — unlike deviceloop
+        # (same batch re-scanned), this is what a training run would see.
+        src = jax.device_put(
+            r.integers(1, top, (n_steps, batch, seq), dtype=np.int32)
+        )
+        tgt = jax.device_put(
+            r.integers(1, top, (n_steps, batch, seq), dtype=np.int32)
+        )
+    else:
+        src = jax.device_put(r.integers(1, top, (batch, seq), dtype=np.int32))
+        tgt = jax.device_put(r.integers(1, top, (batch, seq), dtype=np.int32))
 
     # Donated-state step except for tied-weight configs: donation aliases one
     # buffer into two consumers there, which the TPU backend rejects at
@@ -165,6 +183,13 @@ def bench_config(
             return state, jax.tree.map(lambda x: x[-1], ms)
 
         step = jax.jit(scan_steps, donate_argnums=(0,) if donate else ())
+    elif mode == "multistep":
+        from transformer_tpu.train.trainer import make_multistep_train_step
+
+        step = jax.jit(
+            make_multistep_train_step(make_train_step(model_cfg, train_cfg)),
+            donate_argnums=(0,) if donate else (),
+        )
     else:
         step = jax.jit(
             make_train_step(model_cfg, train_cfg),
@@ -173,7 +198,7 @@ def bench_config(
     if not donate:
         print(f"{name}: tied weights, benchmarking undonated", file=sys.stderr)
 
-    warmups = 2 if mode == "deviceloop" else 3  # compile + settle
+    warmups = 2 if mode in ("deviceloop", "multistep") else 3  # compile + settle
     for _ in range(warmups):
         state, metrics = step(state, src, tgt, rng)
     # Synchronize via a VALUE fetch, not block_until_ready: on tunneled/
@@ -188,7 +213,7 @@ def bench_config(
     )
     with ctx:
         t0 = time.perf_counter()
-        if mode == "deviceloop":
+        if mode in ("deviceloop", "multistep"):
             # ONE dispatch covering all n_steps optimizer steps on device.
             state, metrics = step(state, src, tgt, rng)
             final_loss = float(metrics["loss"])
@@ -237,9 +262,11 @@ def main() -> None:
     )
     ap.add_argument(
         "--modes", default="full",
-        help="comma-separated subset of full,fwd,smallvocab,deviceloop "
-        "(step-time attribution; deviceloop = all steps in one jitted scan, "
-        "isolating per-step dispatch overhead)",
+        help="comma-separated subset of full,fwd,smallvocab,deviceloop,"
+        "multistep (step-time attribution; deviceloop = all steps in one "
+        "jitted scan of ONE batch, isolating per-step dispatch overhead; "
+        "multistep = the production steps_per_dispatch path: distinct "
+        "stacked batches, one transfer + K steps per dispatch)",
     )
     ap.add_argument(
         "--profile_dir", default="",
@@ -261,7 +288,7 @@ def main() -> None:
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
-    known = {"full", "fwd", "smallvocab", "deviceloop"}
+    known = {"full", "fwd", "smallvocab", "deviceloop", "multistep"}
     bad = [m for m in modes if m not in known]
     if bad:  # an unknown mode would silently time the full step mislabeled
         ap.error(f"unknown mode(s) {bad}; choose from {sorted(known)}")

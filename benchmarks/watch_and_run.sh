@@ -108,6 +108,8 @@ missing_extras() {
     || out="$out,b256xs64"
   grep -qF '"metric": "base train throughput [deviceloop]", "value"' "$EXTRA" 2>/dev/null \
     || out="$out,deviceloop"
+  grep -qF '"metric": "base train throughput [multistep]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,multistep"
   [ "$(value_count "base train throughput" "$EXTRA")" -ge 2 ] || out="$out,repbase"
   [ "$(value_count "tiny train throughput" "$EXTRA")" -ge 2 ] || out="$out,reptiny"
   echo "${out#,}"
@@ -237,6 +239,12 @@ while :; do
         timeout 2400 python benchmarks/run.py --configs base --modes deviceloop >>"$EXTRA" 2>>"$ERR"
         rc=$?
         [ "$rc" -ne 0 ] && record_failure "base train throughput [deviceloop]" "$EXTRA" "$rc"
+        ;;
+      multistep)
+        log "running extra: base steps_per_dispatch production-path A/B"
+        timeout 2400 python benchmarks/run.py --configs base --modes multistep >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "base train throughput [multistep]" "$EXTRA" "$rc"
         ;;
       repbase)
         log "running extra: base repeat row (variance/median)"
