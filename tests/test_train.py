@@ -1002,6 +1002,132 @@ class TestExportRoundTrip:
         assert want == got
 
 
+class TestQuantizedExport:
+    def _model(self):
+        # d_model 64 so the big leaves clear the _Q8_MIN_SIZE threshold.
+        cfg = ModelConfig(
+            num_layers=1, d_model=64, num_heads=2, dff=128,
+            input_vocab_size=300, target_vocab_size=300, max_position=32,
+            dtype="float32", dropout_rate=0.0,
+        )
+        return cfg, transformer_init(jax.random.PRNGKey(0), cfg)
+
+    def test_int8_roundtrip_error_bound(self, tmp_path):
+        """Every quantized leaf must come back within half a quantization
+        step of its group scale; small leaves (biases, layernorms) must be
+        bit-exact."""
+        from transformer_tpu.train.checkpoint import (
+            _Q8_MIN_SIZE,
+            _flatten,
+            export_params,
+            load_exported_params,
+        )
+
+        cfg, params = self._model()
+        export_params(params, cfg, str(tmp_path / "q"), quantize="int8")
+        loaded = load_exported_params(str(tmp_path / "q"), params)
+        for (k, want), got in zip(
+            _flatten(params).items(),
+            _flatten(loaded).values(),
+        ):
+            want, got = np.asarray(want), np.asarray(got)
+            if want.ndim < 2 or want.size < _Q8_MIN_SIZE:
+                np.testing.assert_array_equal(want, got, err_msg=k)
+            else:
+                axis = (
+                    -1 if k.endswith("embedding/table")
+                    else tuple(range(want.ndim - 1))
+                )
+                step = np.max(np.abs(want), axis=axis, keepdims=True) / 127.0
+                assert np.all(np.abs(want - got) <= step * 0.5 + 1e-8), k
+
+    def test_int8_artifact_smaller(self, tmp_path):
+        import os
+
+        from transformer_tpu.train.checkpoint import export_params
+
+        cfg, params = self._model()
+        export_params(params, cfg, str(tmp_path / "fp"))
+        export_params(params, cfg, str(tmp_path / "q"), quantize="int8")
+        fp = os.path.getsize(tmp_path / "fp" / "params.npz")
+        q = os.path.getsize(tmp_path / "q" / "params.npz")
+        assert q < fp / 2.5, (fp, q)
+
+    def test_quantized_decode_close(self, tmp_path):
+        """The serving path must work unchanged on a quantized export, and
+        the int8 error must not change a greedy decode of an untrained
+        model's argmax chain wildly — compare logits, not strings."""
+        from transformer_tpu.models import transformer_apply
+        from transformer_tpu.train.checkpoint import (
+            export_params,
+            load_exported_params,
+        )
+
+        cfg, params = self._model()
+        export_params(params, cfg, str(tmp_path / "q"), quantize="int8")
+        loaded = load_exported_params(str(tmp_path / "q"), params)
+        src = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 1, 290)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 1, 290)
+        want, _ = transformer_apply(params, src, tgt, cfg, deterministic=True)
+        got, _ = transformer_apply(loaded, src, tgt, cfg, deterministic=True)
+        err = float(jnp.max(jnp.abs(want - got)))
+        spread = float(jnp.max(want) - jnp.min(want))
+        assert err < 0.05 * spread, (err, spread)
+
+    def test_rejects_unknown_scheme(self, tmp_path):
+        from transformer_tpu.train.checkpoint import export_params
+
+        cfg, params = self._model()
+        with pytest.raises(ValueError, match="quantize"):
+            export_params(params, cfg, str(tmp_path / "x"), quantize="int4")
+
+    def test_moe_biases_stay_exact(self, tmp_path):
+        """Per-expert MoE biases are 2-D and large but additive — they must
+        NOT be quantized (bit-exact roundtrip)."""
+        from transformer_tpu.train.checkpoint import (
+            export_params,
+            load_exported_params,
+        )
+
+        cfg = ModelConfig(
+            num_layers=1, d_model=64, num_heads=2, dff=128,
+            input_vocab_size=300, target_vocab_size=300, max_position=32,
+            dtype="float32", dropout_rate=0.0,
+            moe_experts=8, moe_top_k=2, moe_every=1,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        export_params(params, cfg, str(tmp_path / "q"), quantize="int8")
+        loaded = load_exported_params(str(tmp_path / "q"), params)
+
+        def check(path, want, got):
+            key = "/".join(str(getattr(e, "key", getattr(e, "name", e))) for e in path)
+            if key.endswith("bias"):
+                np.testing.assert_array_equal(
+                    np.asarray(want), np.asarray(got), err_msg=key
+                )
+
+        jax.tree_util.tree_map_with_path(
+            check, params, loaded
+        )
+
+    def test_bfloat16_params_quantize(self, tmp_path):
+        """bf16 leaves must quantize too (ml_dtypes' bfloat16 is not
+        np.floating — matched by dtype name instead)."""
+        import os
+
+        from transformer_tpu.train.checkpoint import export_params
+
+        cfg, params = self._model()
+        bf16 = jax.tree.map(
+            lambda w: np.asarray(w, dtype=jnp.bfloat16.dtype), params
+        )
+        export_params(bf16, cfg, str(tmp_path / "fp"))
+        export_params(bf16, cfg, str(tmp_path / "q"), quantize="int8")
+        fp = os.path.getsize(tmp_path / "fp" / "params.npz")
+        q = os.path.getsize(tmp_path / "q" / "params.npz")
+        assert q < fp / 1.4, (fp, q)  # int8 < bf16 on the big leaves
+
+
 class TestTensorBoardWriter:
     def test_record_framing_and_crc(self, tmp_path):
         w = SummaryWriter(str(tmp_path))

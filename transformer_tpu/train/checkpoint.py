@@ -527,12 +527,65 @@ def average_checkpoints(
     )
 
 
-def export_params(params: Any, model_cfg, path: str) -> None:
+_Q8_SUFFIX = "::q8"
+_Q8_SCALE_SUFFIX = "::q8scale"
+# Leaves below this element count stay fp32 — biases/layernorms are tiny and
+# numerically load-bearing; quantizing them saves nothing.
+_Q8_MIN_SIZE = 1024
+
+
+def _quantize_leaf(key: str, w: np.ndarray) -> dict[str, np.ndarray] | None:
+    """Symmetric int8 weight quantization for one flat leaf, or None to keep
+    it fp. Grouping: embedding tables get one scale PER ROW (each token
+    vector carries its own range — robust to outlier rows of a 32k-row
+    table); everything else one scale per slot of the LAST axis (per-output
+    -channel for (in, out) kernels; per head-dim slot for the pre-split
+    (d_model, H, head_dim) attention projections)."""
+    w = np.asarray(w)
+    # dtype.kind misses bfloat16 (ml_dtypes registers it as kind 'V'), so
+    # match it by name; biases are additive load-bearing terms and stay fp
+    # even when 2-D and large (MoE per-expert biases are (E, dff)).
+    is_float = w.dtype.kind == "f" or w.dtype.name == "bfloat16"
+    if (
+        w.ndim < 2
+        or w.size < _Q8_MIN_SIZE
+        or not is_float
+        or key.endswith("/bias")
+    ):
+        return None
+    axis = -1 if key.endswith("embedding/table") else tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w.astype(np.float32)), axis=axis, keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, 1.0, scale)  # all-zero groups stay zero
+    q = np.clip(np.rint(w.astype(np.float32) / scale), -127, 127).astype(
+        np.int8
+    )
+    return {key + _Q8_SUFFIX: q, key + _Q8_SCALE_SUFFIX: scale}
+
+
+def export_params(
+    params: Any, model_cfg, path: str, quantize: str = ""
+) -> None:
     """Model export for serving — the counterpart of the reference's final
     ``tf.saved_model.save`` (``train.py:246``, README "Model Exporting"):
-    arrays.npz + config.json, loadable without the training stack."""
+    arrays.npz + config.json, loadable without the training stack.
+
+    ``quantize="int8"`` stores every large (>=2-D) weight as symmetric int8
+    plus fp32 scales (~4x smaller artifact than fp32, ~2x smaller than a
+    bf16 checkpoint); ``load_exported_params`` dequantizes transparently, so
+    every decode/eval path works unchanged. Compression is the deliverable —
+    compute still runs in the model dtype after load."""
+    if quantize not in ("", "int8"):
+        raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
     os.makedirs(path, exist_ok=True)
-    np.savez(os.path.join(path, "params.npz"), **_flatten(params))
+    flat = _flatten(params)
+    if quantize == "int8":
+        out: dict[str, np.ndarray] = {}
+        for k, w in flat.items():
+            qleaf = _quantize_leaf(k, w)
+            out.update(qleaf if qleaf is not None else {k: np.asarray(w)})
+        flat = out
+    np.savez(os.path.join(path, "params.npz"), **flat)
     from transformer_tpu.config import config_to_json
 
     with open(os.path.join(path, "config.json"), "w") as f:
@@ -540,11 +593,22 @@ def export_params(params: Any, model_cfg, path: str) -> None:
 
 
 def load_exported_params(path: str, template: Any) -> Any:
+    """Rebuild the param tree from an export, transparently dequantizing any
+    int8-quantized leaves (see ``export_params(quantize="int8")``)."""
     with np.load(os.path.join(path, "params.npz")) as data:
         flat = {k: data[k] for k in data.files}
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
     new_leaves = []
     for p, leaf in leaves_with_path:
         key = _SEP.join(_path_elem(e) for e in p)
-        new_leaves.append(flat[key].astype(np.asarray(leaf).dtype))
+        dtype = np.asarray(leaf).dtype
+        if key in flat:
+            new_leaves.append(flat[key].astype(dtype))
+        elif key + _Q8_SUFFIX in flat:
+            q = flat[key + _Q8_SUFFIX].astype(np.float32)
+            new_leaves.append(
+                (q * flat[key + _Q8_SCALE_SUFFIX]).astype(dtype)
+            )
+        else:
+            raise KeyError(f"export at {path} has no leaf for {key!r}")
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
