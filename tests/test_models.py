@@ -254,3 +254,56 @@ class TestKVCacheDecode:
             np.testing.assert_allclose(
                 np.asarray(step_logits), np.asarray(full_logits[:, t, :]), atol=2e-4
             )
+
+    def test_int8_cache_decode_close_to_fp(self):
+        """kv_cache_int8: cached decode through the int8 cache must track the
+        fp cache's logits within quantization tolerance, and the cache
+        buffers must actually be int8."""
+        import dataclasses
+
+        cfg_q = dataclasses.replace(TINY, kv_cache_int8=True)
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 6))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 5))
+
+        from transformer_tpu.models.encoder import encoder_apply as enc_apply
+
+        enc_mask = make_padding_mask(inp)
+        enc_out, _ = enc_apply(params["encoder"], inp, enc_mask, TINY)
+        caches_fp = init_decoder_caches(TINY, 2, 8)
+        caches_q = init_decoder_caches(cfg_q, 2, 8)
+        assert caches_q[0]["k"].dtype == jnp.int8
+        assert caches_q[0]["k_scale"].dtype == jnp.float32
+        # int8 k/v + fp32 per-row scales must undercut the fp32 cache.
+        nbytes = lambda c: sum(  # noqa: E731
+            v.nbytes for v in c.values() if hasattr(v, "nbytes")
+        )
+        assert nbytes(caches_q[0]) < 0.5 * nbytes(caches_fp[0])
+
+        for t in range(5):
+            fp_logits, caches_fp = transformer_decode_step(
+                params, tar[:, t : t + 1], enc_out, enc_mask, caches_fp,
+                jnp.array(t, jnp.int32), TINY,
+            )
+            q_logits, caches_q = transformer_decode_step(
+                params, tar[:, t : t + 1], enc_out, enc_mask, caches_q,
+                jnp.array(t, jnp.int32), cfg_q,
+            )
+            err = float(jnp.max(jnp.abs(fp_logits - q_logits)))
+            spread = float(jnp.max(fp_logits) - jnp.min(fp_logits))
+            assert err < 0.05 * spread, (t, err, spread)
+
+    def test_int8_cache_greedy_decode_runs(self):
+        """End-to-end greedy decode with the int8 cache (the serving path
+        behind --kv_cache_int8)."""
+        import dataclasses
+
+        from transformer_tpu.train.decode import greedy_decode
+
+        cfg_q = dataclasses.replace(TINY, kv_cache_int8=True)
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 6))
+        out = greedy_decode(
+            params, inp, cfg_q, max_len=6, bos_id=1, eos_id=2
+        )
+        assert out.shape[0] == 2 and out.shape[1] <= 7

@@ -1019,6 +1019,7 @@ class TestQuantizedExport:
         from transformer_tpu.train.checkpoint import (
             _Q8_MIN_SIZE,
             _flatten,
+            _q8_group_axes,
             export_params,
             load_exported_params,
         )
@@ -1031,13 +1032,10 @@ class TestQuantizedExport:
             _flatten(loaded).values(),
         ):
             want, got = np.asarray(want), np.asarray(got)
-            if want.ndim < 2 or want.size < _Q8_MIN_SIZE:
+            if want.ndim < 2 or want.size < _Q8_MIN_SIZE or k.endswith("/bias"):
                 np.testing.assert_array_equal(want, got, err_msg=k)
             else:
-                axis = (
-                    -1 if k.endswith("embedding/table")
-                    else tuple(range(want.ndim - 1))
-                )
+                axis = _q8_group_axes(k, want)
                 step = np.max(np.abs(want), axis=axis, keepdims=True) / 127.0
                 assert np.all(np.abs(want - got) <= step * 0.5 + 1e-8), k
 

@@ -31,6 +31,10 @@ def define_evaluate_flags() -> None:
     flags.DEFINE_integer("beam", 1, "beam size (1 = greedy)")
     flags.DEFINE_integer("limit", 0, "evaluate only the first N pairs (0 = all)")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    flags.DEFINE_boolean(
+        "kv_cache_int8", False,
+        "decode with an int8-quantized KV cache (~2-4x less cache HBM; "
+        "serving-time choice, independent of the export)")
 
 
 def main(argv) -> None:
@@ -48,7 +52,7 @@ def main(argv) -> None:
         read_lines,
     )
 
-    params, model_cfg = load_export(FLAGS.export_path)
+    params, model_cfg = load_export(FLAGS.export_path, kv_cache_int8=FLAGS.kv_cache_int8)
     if model_cfg.decoder_only:
         # LM family: no translation to score — report token perplexity on
         # the target-side text instead.

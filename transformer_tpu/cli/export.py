@@ -39,6 +39,11 @@ def main(argv) -> None:
     from transformer_tpu.cli.flags import apply_preset
 
     apply_preset()  # before ANY direct FLAGS read (e.g. decoder_only)
+    if FLAGS.quantize not in ("", "int8"):
+        # Fail in milliseconds, not after restoring/averaging N checkpoints.
+        raise app.UsageError(
+            f"--quantize must be '' or 'int8', got {FLAGS.quantize!r}"
+        )
     import jax
 
     jax.config.update("jax_platforms", FLAGS.platform or "cpu")

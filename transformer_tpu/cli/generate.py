@@ -27,6 +27,10 @@ def define_generate_flags() -> None:
     flags.DEFINE_float("top_p", 1.0, "nucleus (top-p) truncation for sampling (1 = off)")
     flags.DEFINE_integer("seed", 0, "sampling seed")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    flags.DEFINE_boolean(
+        "kv_cache_int8", False,
+        "decode with an int8-quantized KV cache (~2-4x less cache HBM; "
+        "serving-time choice, independent of the export)")
 
 
 def main(argv) -> None:
@@ -40,7 +44,7 @@ def main(argv) -> None:
     from transformer_tpu.data.tokenizer import SubwordTokenizer
     from transformer_tpu.train.decode import generate
 
-    params, model_cfg = load_export(FLAGS.export_path)
+    params, model_cfg = load_export(FLAGS.export_path, kv_cache_int8=FLAGS.kv_cache_int8)
     if not model_cfg.decoder_only:
         raise app.UsageError(
             "the export is a seq2seq model; use cli.translate instead"

@@ -34,10 +34,17 @@ def define_translate_flags() -> None:
         "attention_weights return (Transformer.py:30-32) as a servable "
         "artifact ('' = off)")
     flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    flags.DEFINE_boolean(
+        "kv_cache_int8", False,
+        "decode with an int8-quantized KV cache (~2-4x less cache HBM; "
+        "serving-time choice, independent of the export)")
 
 
-def load_export(export_path: str):
-    """(params, model_cfg) from an export directory — no trainer needed."""
+def load_export(export_path: str, kv_cache_int8: bool = False):
+    """(params, model_cfg) from an export directory — no trainer needed.
+    ``kv_cache_int8`` opts the loaded model's decode path into the int8 KV
+    cache (a serving-time choice, so it is not baked into the export)."""
+    import dataclasses
     import os
 
     import jax
@@ -48,6 +55,8 @@ def load_export(export_path: str):
 
     with open(os.path.join(export_path, "config.json")) as f:
         model_cfg = config_from_json(ModelConfig, f.read())
+    if kv_cache_int8:
+        model_cfg = dataclasses.replace(model_cfg, kv_cache_int8=True)
     # Template gives load_exported_params the tree structure + dtypes; its
     # (random) values are fully overwritten by the stored arrays.
     template = transformer_init(jax.random.PRNGKey(0), model_cfg)
@@ -65,7 +74,7 @@ def main(argv) -> None:
     from transformer_tpu.data.tokenizer import SubwordTokenizer
     from transformer_tpu.train.decode import translate
 
-    params, model_cfg = load_export(FLAGS.export_path)
+    params, model_cfg = load_export(FLAGS.export_path, kv_cache_int8=FLAGS.kv_cache_int8)
     src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
     tgt_tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
 

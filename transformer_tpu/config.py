@@ -78,6 +78,11 @@ class ModelConfig:
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(layers) less activation
     # HBM — the standard lever for long-context configs (BASELINE configs[4]).
     remat: bool = False
+    # int8 decode KV cache (ops/attention.py init_cache(quantize=True)):
+    # k/v stored int8 with one fp32 scale per (position, head) row,
+    # dequantized on read — ~2x (vs bf16) to ~4x (vs fp32) less HBM for the
+    # long-context serving bottleneck. Decode-only; training is unaffected.
+    kv_cache_int8: bool = False
     # Mixture-of-Experts FFN (capability extension; the reference's FFN is
     # dense, ``point_ffn.py:3-7``). 0 = dense FFN everywhere. When > 0, every
     # ``moe_every``-th layer replaces its FFN with a ``moe_experts``-expert

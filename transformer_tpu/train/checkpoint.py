@@ -534,13 +534,26 @@ _Q8_SCALE_SUFFIX = "::q8scale"
 _Q8_MIN_SIZE = 1024
 
 
+def _q8_group_axes(key: str, w: np.ndarray):
+    """Reduction axes for one leaf's quantization groups. Embedding tables:
+    one scale PER ROW (each token vector carries its own range — robust to
+    outlier rows of a 32k-row table). 3-D+ kernels keep the last TWO axes
+    when the reduced axes still hold >= 16 values — per-(head, slot) scales
+    for the pre-split (d_model, H, head_dim) attention projections, so one
+    outlier head cannot inflate every head's scale — at negligible scale
+    storage. Everything else (2-D kernels; the (H, head_dim, d_model) out
+    projection, where keeping two axes would cost 50% overhead): one scale
+    per slot of the last axis, i.e. per output channel."""
+    if key.endswith("embedding/table"):
+        return -1
+    if w.ndim >= 3 and int(np.prod(w.shape[:-2])) >= 16:
+        return tuple(range(w.ndim - 2))
+    return tuple(range(w.ndim - 1))
+
+
 def _quantize_leaf(key: str, w: np.ndarray) -> dict[str, np.ndarray] | None:
     """Symmetric int8 weight quantization for one flat leaf, or None to keep
-    it fp. Grouping: embedding tables get one scale PER ROW (each token
-    vector carries its own range — robust to outlier rows of a 32k-row
-    table); everything else one scale per slot of the LAST axis (per-output
-    -channel for (in, out) kernels; per head-dim slot for the pre-split
-    (d_model, H, head_dim) attention projections)."""
+    it fp (grouping: ``_q8_group_axes``)."""
     w = np.asarray(w)
     # dtype.kind misses bfloat16 (ml_dtypes registers it as kind 'V'), so
     # match it by name; biases are additive load-bearing terms and stay fp
@@ -553,7 +566,7 @@ def _quantize_leaf(key: str, w: np.ndarray) -> dict[str, np.ndarray] | None:
         or key.endswith("/bias")
     ):
         return None
-    axis = -1 if key.endswith("embedding/table") else tuple(range(w.ndim - 1))
+    axis = _q8_group_axes(key, w)
     amax = np.max(np.abs(w.astype(np.float32)), axis=axis, keepdims=True)
     scale = (amax / 127.0).astype(np.float32)
     scale = np.where(scale == 0.0, 1.0, scale)  # all-zero groups stay zero
