@@ -113,6 +113,11 @@ def bench_config(
 
     ``loss_chunks > 1`` additionally runs the chunked vocab-projection/CE
     path (TrainConfig.loss_chunks) for A/B against the monolithic loss.
+
+    Serving-side modes (the reference has no working decode to measure,
+    SURVEY §2.3.2/.11 — these rows are framework-only):
+    - decode:    KV-cached greedy decode, generated tokens/sec.
+    - decodeq8:  same with the int8 KV cache (--kv_cache_int8 A/B).
     """
     import dataclasses
 
@@ -126,6 +131,8 @@ def bench_config(
     )
 
     model_cfg, train_cfg, batch, seq = _configs()[name]
+    if mode in ("decode", "decodeq8"):
+        return _bench_decode(name, model_cfg, batch, seq, n_steps, mode)
     if batch_override or seq_override:
         # MFU-ceiling probes: the BASELINE shapes are fixed for comparability,
         # but utilization scales with tokens/step — overrides find the knee.
@@ -253,6 +260,66 @@ def bench_config(
     }
 
 
+def _bench_decode(
+    name: str, model_cfg, batch: int, seq: int, n_iters: int, mode: str
+) -> dict:
+    """Greedy-decode throughput: generated tokens/sec with the KV cache
+    (fp, or int8 when mode == 'decodeq8'). EOS is set outside the vocab so
+    every row decodes the full max_len — deterministic token counts."""
+    import dataclasses
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from transformer_tpu.train.decode import greedy_decode
+
+    if mode == "decodeq8":
+        model_cfg = dataclasses.replace(model_cfg, kv_cache_int8=True)
+    # Serving shape: decode length = the config's training sequence length,
+    # batch capped so the long4k cache fits comfortably.
+    batch = min(batch, 32)
+    max_len = min(seq, 128)
+    src_len = min(seq, 64)
+    dev = jax.devices()[0]
+    from transformer_tpu.models import transformer_init
+
+    if model_cfg.decoder_only:
+        # Greedy seq2seq decode needs an encoder; LM configs measure via the
+        # same cache path in cli.generate — skip here rather than mislabel.
+        raise SystemExit(f"{name}: decoder-only configs have no seq2seq decode")
+    params = transformer_init(jax.random.PRNGKey(0), model_cfg)
+    r = np.random.default_rng(0)
+    src = jax.device_put(
+        r.integers(1, model_cfg.input_vocab_size - 2, (batch, src_len), dtype=np.int32)
+    )
+    run = lambda: greedy_decode(  # noqa: E731
+        params, src, model_cfg, max_len=max_len,
+        bos_id=model_cfg.target_vocab_size - 2,
+        eos_id=model_cfg.target_vocab_size + 7,  # unreachable: full-length rows
+    )
+    out = run()
+    np.asarray(out)  # VALUE-fetch sync (block_until_ready lies via tunnel)
+    t0 = _time.perf_counter()
+    for _ in range(n_iters):
+        out = run()
+    np.asarray(out)
+    dt = _time.perf_counter() - t0
+    value = batch * max_len * n_iters / dt
+    return {
+        "metric": f"{name} decode throughput [{mode}]",
+        "value": round(value, 1),
+        "unit": "generated tokens/sec/chip",
+        "config": {
+            "batch": batch, "src_len": src_len, "max_len": max_len,
+            "kv_cache_int8": model_cfg.kv_cache_int8,
+        },
+        "ms_per_token": round(dt / (max_len * n_iters) * 1e3, 3),
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "vs_baseline": None,  # reference decode is broken (SURVEY §2.3.2/.11)
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
@@ -288,7 +355,10 @@ def main() -> None:
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
-    known = {"full", "fwd", "smallvocab", "deviceloop", "multistep"}
+    known = {
+        "full", "fwd", "smallvocab", "deviceloop", "multistep",
+        "decode", "decodeq8",
+    }
     bad = [m for m in modes if m not in known]
     if bad:  # an unknown mode would silently time the full step mislabeled
         ap.error(f"unknown mode(s) {bad}; choose from {sorted(known)}")

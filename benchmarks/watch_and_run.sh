@@ -71,6 +71,7 @@ extra_metric() {
   case "$1" in
     repbase) echo "base train throughput" ;;
     reptiny) echo "tiny train throughput" ;;
+    decode|decodeq8) echo "base decode throughput [$1]" ;;
     *) echo "base train throughput [$1]" ;;
   esac
 }
@@ -110,6 +111,10 @@ missing_extras() {
     || out="$out,deviceloop"
   grep -qF '"metric": "base train throughput [multistep]", "value"' "$EXTRA" 2>/dev/null \
     || out="$out,multistep"
+  grep -qF '"metric": "base decode throughput [decode]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,decode"
+  grep -qF '"metric": "base decode throughput [decodeq8]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,decodeq8"
   [ "$(value_count "base train throughput" "$EXTRA")" -ge 2 ] || out="$out,repbase"
   [ "$(value_count "tiny train throughput" "$EXTRA")" -ge 2 ] || out="$out,reptiny"
   echo "${out#,}"
@@ -245,6 +250,12 @@ while :; do
         timeout 2400 python benchmarks/run.py --configs base --modes multistep >>"$EXTRA" 2>>"$ERR"
         rc=$?
         [ "$rc" -ne 0 ] && record_failure "base train throughput [multistep]" "$EXTRA" "$rc"
+        ;;
+      decode|decodeq8)
+        log "running extra: base greedy-decode throughput [$PICK]"
+        timeout 2400 python benchmarks/run.py --configs base --modes "$PICK" >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "base decode throughput [$PICK]" "$EXTRA" "$rc"
         ;;
       repbase)
         log "running extra: base repeat row (variance/median)"
