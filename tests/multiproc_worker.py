@@ -101,6 +101,31 @@ def main() -> None:
     # Numerics must match the flat-mesh run on the same batches.
     hlosses, _ = run_steps(MeshConfig(data=4, fsdp=2, dcn_data=2))
 
+    # Cross-process consistency sanitizer (utils/consistency.py — the §5
+    # "race detection" equivalent), exercised for real across 2 processes:
+    # identical replicated state passes; per-process divergence is caught;
+    # legitimately-sharded leaves (fsdp state above) are skipped, not
+    # false-positived.
+    from transformer_tpu.utils.consistency import (
+        assert_cross_process_consistent,
+    )
+
+    consistency_ok = True
+    try:
+        assert_cross_process_consistent(
+            {"w": np.arange(8, dtype=np.float32)}, label="same-everywhere"
+        )
+        assert_cross_process_consistent(state.params, label="sharded-skip")
+    except RuntimeError:
+        consistency_ok = False
+    divergence_caught = False
+    try:
+        assert_cross_process_consistent(
+            {"w": np.arange(8, dtype=np.float32) + pid}, label="diverged"
+        )
+    except RuntimeError:
+        divergence_caught = True
+
     print(
         json.dumps(
             {
@@ -108,6 +133,8 @@ def main() -> None:
                 "losses": [round(l, 6) for l in losses],
                 "hybrid_losses": [round(l, 6) for l in hlosses],
                 "restore_checksum": checksum,
+                "consistency_ok": consistency_ok,
+                "divergence_caught": divergence_caught,
                 "n_processes": jax.process_count(),
                 "n_devices": len(jax.devices()),
             }

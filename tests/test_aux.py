@@ -182,3 +182,53 @@ class TestTreeChecksum:
             return tree_checksum(state.params)
 
         assert run() == run()
+
+
+class TestConsistency:
+    """utils/consistency.py — the SURVEY §5 'race detection' equivalent.
+    (The real 2-process positive/negative checks run in
+    tests/test_multiprocess.py via multiproc_worker.py.)"""
+
+    def test_fingerprint_detects_change(self):
+        from transformer_tpu.utils.consistency import (
+            fingerprints_equal,
+            tree_fingerprint,
+        )
+
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        a = tree_fingerprint(params)
+        b = tree_fingerprint(params)
+        assert fingerprints_equal(a, b) == []
+        bumped = jax.tree.map(lambda x: x, params)
+        bumped["final"]["bias"] = params["final"]["bias"] + 1e-3
+        diff = fingerprints_equal(a, tree_fingerprint(bumped))
+        assert diff == ["final/bias"], diff
+
+    def test_single_process_consistency_trivially_passes(self):
+        from transformer_tpu.utils.consistency import (
+            assert_cross_process_consistent,
+        )
+
+        params = transformer_init(jax.random.PRNGKey(0), TINY)
+        assert_cross_process_consistent(params)  # must not raise
+
+    def test_step_determinism_assert(self):
+        from transformer_tpu.train import make_train_step
+        from transformer_tpu.utils.consistency import (
+            assert_step_deterministic,
+        )
+
+        state = create_train_state(jax.random.PRNGKey(0), TINY, TCFG)
+        step = jax.jit(make_train_step(TINY, TCFG))  # NOT donated
+        src = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (4, 8), 1, 30))
+        tgt = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (4, 8), 1, 30))
+        assert_step_deterministic(step, state, src, tgt, jax.random.PRNGKey(3))
+
+        calls = []
+
+        def impure(x):
+            calls.append(1)
+            return np.float32(len(calls)) * np.asarray(x)
+
+        with pytest.raises(RuntimeError, match="nondeterministic"):
+            assert_step_deterministic(impure, np.ones(3), label="impure fn")

@@ -80,6 +80,12 @@ def test_two_process_training_matches_single(tmp_path):
     np.testing.assert_allclose(
         outs[0]["hybrid_losses"], outs[0]["losses"], atol=2e-5
     )
+    # Consistency sanitizer (utils/consistency.py): identical replicated
+    # state passes (and fsdp-sharded leaves are skipped, not false-
+    # positived), while per-process divergence is detected on BOTH hosts.
+    for o in outs:
+        assert o["consistency_ok"], o
+        assert o["divergence_caught"], o
 
     # The 2-process run must match the single-process 8-device oracle.
     import jax

@@ -111,7 +111,20 @@ def main(argv) -> None:
         log_fn=logging.info,
         profiler=flags_to_profiler() if jax.process_index() == 0 else None,
     )
-    trainer.fit(train_ds, test_ds)
+    if FLAGS.consistency_check:
+        from transformer_tpu.utils.consistency import (
+            assert_cross_process_consistent,
+        )
+
+        def check_consistency(epoch, tr):
+            assert_cross_process_consistent(
+                tr.state.params, label=f"params after epoch {epoch + 1}"
+            )
+
+        trainer.fit(train_ds, test_ds, epoch_callback=check_consistency)
+        assert_cross_process_consistent(trainer.state.params, label="final params")
+    else:
+        trainer.fit(train_ds, test_ds)
 
     # Multi-host: params are sharded across processes, but the epilogue
     # (sample decode, export, BLEU) runs on host 0 alone — device_get/jit on

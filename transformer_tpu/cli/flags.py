@@ -198,6 +198,11 @@ def define_flags() -> None:
         "(1 = off) — amortizes per-step dispatch overhead when step times "
         "are small; log/eval/preemption granularity becomes this many steps")
     flags.DEFINE_boolean(
+        "consistency_check", False,
+        "after every epoch (and at end of run), assert that all processes "
+        "hold bit-identical replicated state (catches silent per-host "
+        "RNG/data-order divergence; utils/consistency.py)")
+    flags.DEFINE_boolean(
         "async_checkpoint", False,
         "write checkpoints from a background thread (device snapshot stays "
         "synchronous); multi-process sharded states fall back to sync saves")

@@ -1,0 +1,132 @@
+"""Cross-replica / determinism sanitizers.
+
+The reference has no concurrency checks at all (SURVEY §5 "race detection /
+sanitizers: absent entirely" — its only concurrency surface is the mirrored
+strategy, ``distributed_train.py:58-62``). On a TPU pod the equivalent risks
+are real and silent: per-process RNG or data-order divergence leaves each
+host training a slightly different model (replicated arrays stop being
+replicas), and a nondeterministic collective or seed bug makes runs
+unreproducible. These helpers make both failure modes assertable:
+
+- :func:`tree_fingerprint` — bit-exact per-leaf digest of a pytree.
+- :func:`assert_cross_process_consistent` — every process must hold
+  bit-identical bytes for (logically replicated) arrays.
+- :func:`assert_step_deterministic` — the same jitted step on the same
+  inputs must produce bit-identical outputs.
+
+All comparisons are over raw bytes (crc32), never float equality: NaN-laden
+but identical state compares equal (a loss blowup must read as a numerics
+problem, not a fake replication bug), and no two genuinely different byte
+patterns compare equal through a lossy stats summary.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any
+
+import jax
+import numpy as np
+
+from transformer_tpu.train.checkpoint import _SEP, _path_elem
+
+
+def _leaf_items(tree: Any):
+    """(flat key, ORIGINAL leaf) pairs — same key scheme as the checkpoint
+    format, leaves untouched (no device_get) so callers can inspect
+    shardings before deciding to fetch."""
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for p, leaf in leaves_with_path:
+        yield _SEP.join(_path_elem(e) for e in p), leaf
+
+
+def _leaf_crc(leaf: Any) -> int:
+    """Bit-exact digest of one leaf: crc32 over dtype, shape, and raw bytes
+    (host leaves as-is; device arrays fetched)."""
+    a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+    h = zlib.crc32(f"{a.dtype}:{a.shape}:".encode())
+    return zlib.crc32(a.tobytes(), h) & 0xFFFFFFFF
+
+
+def _is_comparable(leaf: Any) -> bool:
+    """Only fully-replicated device arrays (and plain host arrays) are
+    required to be byte-identical across processes — sharded leaves (FSDP/
+    TP/EP) legitimately hold different index ranges per process and are
+    kept consistent by GSPMD itself. Checked on the ORIGINAL leaf, before
+    any device_get: fetching a multi-host-sharded array would raise (spans
+    non-addressable devices), not skip."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return True  # host-side numpy: every process derived it identically
+    return bool(sharding.is_fully_replicated)
+
+
+def tree_fingerprint(tree: Any) -> dict[str, int]:
+    """Bit-exact digest of a pytree: one crc32 per leaf, keyed by the same
+    flat path names the checkpoint format uses, so mismatches name the
+    offending parameter."""
+    return {key: _leaf_crc(leaf) for key, leaf in _leaf_items(tree)}
+
+
+def fingerprints_equal(a: dict[str, int], b: dict[str, int]) -> list[str]:
+    """Names of leaves whose digests differ."""
+    bad = [k for k in a if a[k] != b.get(k)]
+    bad += [k for k in b if k not in a]
+    return sorted(set(bad))
+
+
+def assert_cross_process_consistent(tree: Any, label: str = "params") -> None:
+    """Every process must hold bit-identical bytes for the REPLICATED
+    leaves of ``tree`` (see :func:`_is_comparable`).
+
+    Catches silent replica divergence (per-host RNG/data-order bugs).
+    Single-process: trivially passes, without fetching anything. Multi-
+    process: one crc per kept leaf is allgathered over the DCN and compared
+    on every host; raises ``RuntimeError`` naming the first diverged
+    leaves.
+    """
+    if jax.process_count() == 1:
+        return
+    keys, crcs = [], []
+    for key, leaf in _leaf_items(tree):
+        if not _is_comparable(leaf):
+            continue
+        keys.append(key)
+        crcs.append(_leaf_crc(leaf))
+    if not keys:
+        return  # everything sharded (pure FSDP/TP): nothing replicated to compare
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(crcs, dtype=np.uint32)
+    gathered = np.asarray(multihost_utils.process_allgather(local))  # (P, L)
+    mismatch = (gathered != gathered[0:1]).any(axis=0)
+    if mismatch.any():
+        bad = [keys[i] for i in np.flatnonzero(mismatch)]
+        raise RuntimeError(
+            f"cross-process divergence in {label}: {len(bad)} leaves differ "
+            f"across the {gathered.shape[0]} processes, starting with "
+            f"{bad[:5]} — replicated state is no longer replicated "
+            "(per-host RNG or data-order bug)"
+        )
+
+
+def assert_step_deterministic(
+    step_fn, *args, label: str = "train step"
+) -> None:
+    """Run ``step_fn(*args)`` twice and require bit-identical outputs.
+
+    Catches nondeterministic lowering/collectives and impure step functions.
+    ``step_fn`` must not donate its inputs (donation would poison the second
+    call); build an undonated step for the check.
+    """
+    out1 = jax.device_get(step_fn(*args))
+    out2 = jax.device_get(step_fn(*args))
+    leaves1, leaves2 = jax.tree.leaves(out1), jax.tree.leaves(out2)
+    for i, (a, b) in enumerate(zip(leaves1, leaves2)):
+        a = np.ascontiguousarray(np.asarray(a))
+        b = np.ascontiguousarray(np.asarray(b))
+        if a.dtype != b.dtype or a.shape != b.shape or a.tobytes() != b.tobytes():
+            raise RuntimeError(
+                f"{label} is nondeterministic: output leaf {i} differs "
+                "between two identical invocations"
+            )
