@@ -284,20 +284,40 @@ def _bench_decode(
     dev = jax.devices()[0]
     from transformer_tpu.models import transformer_init
 
-    if model_cfg.decoder_only:
-        # Greedy seq2seq decode needs an encoder; LM configs measure via the
-        # same cache path in cli.generate — skip here rather than mislabel.
-        raise SystemExit(f"{name}: decoder-only configs have no seq2seq decode")
     params = transformer_init(jax.random.PRNGKey(0), model_cfg)
     r = np.random.default_rng(0)
-    src = jax.device_put(
-        r.integers(1, model_cfg.input_vocab_size - 2, (batch, src_len), dtype=np.int32)
-    )
-    run = lambda: greedy_decode(  # noqa: E731
-        params, src, model_cfg, max_len=max_len,
-        bos_id=model_cfg.target_vocab_size - 2,
-        eos_id=model_cfg.target_vocab_size + 7,  # unreachable: full-length rows
-    )
+    if model_cfg.decoder_only:
+        # Long-context LM continuation — the int8-KV-cache showcase shape:
+        # a long prompt fills the cache (prefill rides the same scan), then
+        # generation attends over the whole context every step.
+        from transformer_tpu.train.decode import lm_generate
+
+        batch = min(batch, 4)
+        prompt_len = min(seq // 2, 2048)
+        max_len = min(seq - prompt_len, 512)
+        prompt = jax.device_put(
+            r.integers(
+                1, model_cfg.target_vocab_size - 2, (batch, prompt_len),
+                dtype=np.int32,
+            )
+        )
+        run = lambda: lm_generate(  # noqa: E731
+            params, prompt, model_cfg, max_new=max_len,
+            eos_id=model_cfg.target_vocab_size + 7,  # unreachable: full rows
+        )
+        src_len = prompt_len
+    else:
+        src = jax.device_put(
+            r.integers(
+                1, model_cfg.input_vocab_size - 2, (batch, src_len),
+                dtype=np.int32,
+            )
+        )
+        run = lambda: greedy_decode(  # noqa: E731
+            params, src, model_cfg, max_len=max_len,
+            bos_id=model_cfg.target_vocab_size - 2,
+            eos_id=model_cfg.target_vocab_size + 7,  # unreachable: full-length rows
+        )
     out = run()
     np.asarray(out)  # VALUE-fetch sync (block_until_ready lies via tunnel)
     t0 = _time.perf_counter()

@@ -72,6 +72,8 @@ extra_metric() {
     repbase) echo "base train throughput" ;;
     reptiny) echo "tiny train throughput" ;;
     decode|decodeq8) echo "base decode throughput [$1]" ;;
+    ldecode) echo "long4k decode throughput [decode]" ;;
+    ldecodeq8) echo "long4k decode throughput [decodeq8]" ;;
     *) echo "base train throughput [$1]" ;;
   esac
 }
@@ -115,6 +117,10 @@ missing_extras() {
     || out="$out,decode"
   grep -qF '"metric": "base decode throughput [decodeq8]", "value"' "$EXTRA" 2>/dev/null \
     || out="$out,decodeq8"
+  grep -qF '"metric": "long4k decode throughput [decode]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,ldecode"
+  grep -qF '"metric": "long4k decode throughput [decodeq8]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,ldecodeq8"
   [ "$(value_count "base train throughput" "$EXTRA")" -ge 2 ] || out="$out,repbase"
   [ "$(value_count "tiny train throughput" "$EXTRA")" -ge 2 ] || out="$out,reptiny"
   echo "${out#,}"
@@ -256,6 +262,13 @@ while :; do
         timeout 2400 python benchmarks/run.py --configs base --modes "$PICK" >>"$EXTRA" 2>>"$ERR"
         rc=$?
         [ "$rc" -ne 0 ] && record_failure "base decode throughput [$PICK]" "$EXTRA" "$rc"
+        ;;
+      ldecode|ldecodeq8)
+        M=${PICK#l}
+        log "running extra: long4k LM-decode throughput [$M]"
+        timeout 2400 python benchmarks/run.py --configs long4k --modes "$M" --steps 3 >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "long4k decode throughput [$M]" "$EXTRA" "$rc"
         ;;
       repbase)
         log "running extra: base repeat row (variance/median)"
