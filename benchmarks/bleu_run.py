@@ -268,6 +268,44 @@ def main() -> None:
         flush=True,
     )
 
+    # The greedy headline is committed above; now rescore the SAME model
+    # with the two quality levers validated at tiny scale (BASELINE.md):
+    # beam-4 and checkpoint averaging. Extra JSON lines, best-effort — a
+    # decode failure here must not cost the recorded headline.
+    def _rescore(tag: str, p, beam: int) -> None:
+        try:
+            t = time.perf_counter()
+            b, _ = bleu_on_pairs(
+                p, model_cfg, src_tok, tgt_tok, src_lines, ref_lines,
+                batch_size=args.batch, max_len=args.bleu_max_len,
+                beam_size=beam,
+            )
+            print(
+                json.dumps(
+                    {
+                        "metric": f"{args.config} corpus BLEU [{tag}]",
+                        "bleu": round(b, 2),
+                        "n_pairs": len(src_lines),
+                        "holdout": bool(args.holdout),
+                        "eval_seconds": round(time.perf_counter() - t, 1),
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"rescore [{tag}] failed: {e!r}", file=sys.stderr)
+
+    _rescore("beam4", trainer.state.params, beam=4)
+    steps = ckpt.all_steps()[-2:]
+    if len(steps) > 1:
+        from transformer_tpu.train.checkpoint import average_checkpoints
+
+        # trainer.state is the live template (the init-time `state` buffers
+        # were donated into the jitted step).
+        avg = average_checkpoints(ckpt, trainer.state, steps)
+        _rescore(f"avg{len(steps)}+greedy", avg, beam=1)
+        _rescore(f"avg{len(steps)}+beam4", avg, beam=4)
+
 
 if __name__ == "__main__":
     main()
