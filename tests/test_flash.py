@@ -47,6 +47,48 @@ class TestForward:
         want, _ = dot_product_attention(q, k, v, mask)
         np.testing.assert_allclose(got, want, atol=2e-6)
 
+    def test_sliding_window(self, rng):
+        """window=W must equal the banded causal mask oracle — including
+        windows that are not block-aligned (tile-interior banding) and
+        smaller than a block (whole tiles skipped below the band)."""
+        from transformer_tpu.ops.masks import make_causal_mask
+
+        q, k, v = _qkv(rng)
+        for w in (5, 32, 48):
+            got = flash_attention(
+                q, k, v, causal=True, window=w, block_q=32, block_k=32
+            )
+            want, _ = dot_product_attention(
+                q, k, v, make_causal_mask(64, window=w)
+            )
+            np.testing.assert_allclose(got, want, atol=2e-6, err_msg=f"w={w}")
+
+    def test_window_grads_match_xla(self, rng):
+        from transformer_tpu.ops.masks import make_causal_mask
+
+        q, k, v = _qkv(rng)
+        mask = make_causal_mask(64, window=20)
+
+        def f_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=True, window=20, block_q=32, block_k=32
+            )
+            return (out**2).sum()
+
+        def f_xla(q, k, v):
+            out, _ = dot_product_attention(q, k, v, mask)
+            return (out**2).sum()
+
+        got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, atol=5e-5)
+
+    def test_window_requires_causal(self, rng):
+        q, k, v = _qkv(rng, s=32)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, window=8)
+
     def test_padding_and_causal(self, rng):
         q, k, v = _qkv(rng)
         kv_mask = jnp.asarray(rng.integers(0, 2, (2, 64)), bool).at[:, :4].set(True)

@@ -255,6 +255,43 @@ class TestKVCacheDecode:
                 np.asarray(step_logits), np.asarray(full_logits[:, t, :]), atol=2e-4
             )
 
+    def test_windowed_cache_decode_matches_full_forward(self):
+        """attention_window: the cached decode's banded prefix mask must
+        reproduce the banded training mask — per-position logits equal the
+        full (non-cached) windowed forward."""
+        import dataclasses
+
+        cfg_w = dataclasses.replace(
+            TINY, decoder_only=True, attention_window=3
+        )
+        params = transformer_init(jax.random.PRNGKey(0), cfg_w)
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 8))
+        full_logits, _ = transformer_apply(params, None, tar, cfg_w)
+
+        caches = init_decoder_caches(cfg_w, 2, 9)
+        for t in range(8):
+            step_logits, caches = transformer_decode_step(
+                params, tar[:, t : t + 1], None, None, caches,
+                jnp.array(t, jnp.int32), cfg_w,
+            )
+            np.testing.assert_allclose(
+                np.asarray(step_logits), np.asarray(full_logits[:, t, :]),
+                atol=2e-4, err_msg=f"t={t}",
+            )
+        # The window must actually bite: a full-attention model differs.
+        full_cfg = dataclasses.replace(cfg_w, attention_window=0)
+        unwindowed, _ = transformer_apply(params, None, tar, full_cfg)
+        assert not np.allclose(
+            np.asarray(full_logits[:, -1]), np.asarray(unwindowed[:, -1]),
+            atol=1e-5,
+        )
+
+    def test_window_rejects_seq_parallel_impls(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="attention_window"):
+            dataclasses.replace(TINY, attention_impl="ring", attention_window=4)
+
     def test_int8_cache_decode_close_to_fp(self):
         """kv_cache_int8: cached decode through the int8 cache must track the
         fp cache's logits within quantization tolerance, and the cache

@@ -193,6 +193,11 @@ def define_flags() -> None:
         "the full (B,S,V) logits tensor is never materialized (1 = off) — "
         "the memory lever for big-vocab/long-context configs")
     flags.DEFINE_integer(
+        "attention_window", 0,
+        "sliding-window causal self-attention: each position attends only "
+        "the last N positions (0 = full attention); structural tile-skip "
+        "in the flash kernel, banded mask under xla, honored by decode")
+    flags.DEFINE_integer(
         "steps_per_dispatch", 1,
         "optimizer steps per host dispatch, run inside one jitted lax.scan "
         "(1 = off) — amortizes per-step dispatch overhead when step times "
@@ -234,6 +239,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         ffn_activation=FLAGS.ffn_activation,
         dtype=FLAGS.dtype,
         attention_impl=FLAGS.attention_impl,
+        attention_window=FLAGS.attention_window,
         remat=FLAGS.remat,
         moe_experts=FLAGS.moe_experts,
         moe_top_k=FLAGS.moe_top_k,

@@ -78,6 +78,14 @@ class ModelConfig:
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(layers) less activation
     # HBM — the standard lever for long-context configs (BASELINE configs[4]).
     remat: bool = False
+    # Sliding-window (local) attention for CAUSAL self-attention: each
+    # position attends only the last `attention_window` positions
+    # (Mistral-style). Applies to decoder self-attention and decoder-only
+    # LMs; encoder self-attention and cross-attention are unaffected.
+    # Structural in the flash kernel (out-of-band tiles skipped: per-row
+    # compute O(window), not O(S)); banded mask under xla; honored by the
+    # KV-cache decode path. Not supported with ring/ulysses. 0 = full.
+    attention_window: int = 0
     # int8 decode KV cache (ops/attention.py init_cache(quantize=True)):
     # k/v stored int8 with one fp32 scale per (position, head) row,
     # dequantized on read — ~2x (vs bf16) to ~4x (vs fp32) less HBM for the
@@ -102,6 +110,15 @@ class ModelConfig:
             )
         if self.norm_scheme not in ("post", "pre"):
             raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
+        if self.attention_window < 0:
+            raise ValueError(
+                f"attention_window must be >= 0, got {self.attention_window}"
+            )
+        if self.attention_window and self.attention_impl in ("ring", "ulysses"):
+            raise ValueError(
+                "attention_window is not supported with sequence-parallel "
+                "attention (ring/ulysses); use attention_impl='flash'"
+            )
         if self.position_scheme not in ("sinusoidal", "rope"):
             raise ValueError(
                 f"position_scheme must be 'sinusoidal' or 'rope', got "

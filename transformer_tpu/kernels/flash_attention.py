@@ -60,6 +60,11 @@ class _FlashConfig:
     # INDEX MAPS — kv is never materialized at the full head count, so HBM kv
     # traffic stays at the H_kv rate (the whole point of GQA).
     num_kv_heads: int = 0  # 0 = same as num_heads (plain MHA)
+    # Causal sliding window (Mistral-style local attention): row r attends
+    # cols in [r - window + 1, r]. 0 = unbounded. Structural like causality:
+    # tiles fully OUTSIDE the band (above the diagonal or below the window)
+    # are skipped by _visible, so compute per q-block is O(window), not O(S).
+    window: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -110,8 +115,16 @@ def _compiler_params(dimension_semantics: tuple[str, ...]):
 
 
 def _visible(cfg: _FlashConfig, i, j):
-    """Whether k-block j has any position visible to q-block i under causality."""
-    return j * cfg.block_k <= i * cfg.block_q + cfg.block_q - 1
+    """Whether k-block j has any position visible to q-block i under
+    causality (and, when set, the sliding window)."""
+    vis = j * cfg.block_k <= i * cfg.block_q + cfg.block_q - 1
+    if cfg.window:
+        # Band lower edge: the tile's last col must reach the highest row's
+        # window start (row - window + 1).
+        vis = jnp.logical_and(
+            vis, j * cfg.block_k + cfg.block_k - 1 >= i * cfg.block_q - cfg.window + 1
+        )
+    return vis
 
 
 def _tile_bias(cfg: _FlashConfig, s, i, j, mask_ref):
@@ -129,7 +142,10 @@ def _tile_bias(cfg: _FlashConfig, s, i, j, mask_ref):
         cols = j * cfg.block_k + jax.lax.broadcasted_iota(
             jnp.int32, (cfg.block_q, cfg.block_k), 1
         )
-        s = jnp.where(cols <= rows, s, _MASKED)
+        allowed = cols <= rows
+        if cfg.window:
+            allowed = jnp.logical_and(allowed, cols > rows - cfg.window)
+        s = jnp.where(allowed, s, _MASKED)
     return s
 
 
@@ -638,6 +654,7 @@ def flash_attention(
     *,
     kv_mask: jax.Array | None = None,
     causal: bool = False,
+    window: int = 0,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
@@ -654,6 +671,9 @@ def flash_attention(
         (the padding mask of ``ops.masks.make_padding_mask`` squeezed to 2D).
       causal: structural causal masking (requires S_q == S_k positions to be
         aligned, as in self-attention).
+      window: causal sliding window (requires ``causal``): row r attends
+        cols in [r - window + 1, r]. Structural like causality — tiles
+        outside the band are skipped, so per-row compute is O(window).
       block_q, block_k: tile sizes; shrunk to the largest divisor of the
         sequence length at or below the request.
       interpret: run in Pallas interpret mode. Default: True off-TPU, so the
@@ -674,6 +694,8 @@ def flash_attention(
         )
     if causal and s_q != s_k:
         raise ValueError("causal flash attention requires S_q == S_k")
+    if window and not causal:
+        raise ValueError("window requires causal=True (causal sliding window)")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -702,6 +724,7 @@ def flash_attention(
         scale=d**-0.5,
         interpret=bool(interpret),
         num_kv_heads=h_kv,
+        window=int(window),
     )
 
     # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows (kv

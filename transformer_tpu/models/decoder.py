@@ -90,6 +90,7 @@ def decoder_layer_apply(
             params["self_mha"], h, h, self_mask,
             impl=cfg.attention_impl,
             causal=cache is None,  # cache path builds its own prefix mask
+            window=cfg.attention_window,
             return_weights=return_weights,
             cache=cache,
             flash_block_q=cfg.flash_block_q,

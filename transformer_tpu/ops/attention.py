@@ -162,6 +162,7 @@ def mha_apply(
     *,
     impl: str = "xla",
     causal: bool = False,
+    window: int = 0,
     return_weights: bool = False,
     cache: dict[str, Any] | None = None,
     precomputed_kv: tuple[jax.Array, jax.Array] | None = None,
@@ -180,6 +181,10 @@ def mha_apply(
       impl: "xla" | "flash" (Pallas blockwise kernel; no attention-weight
         output).
       causal: enforce causality; ANDed with any provided ``mask``.
+      window: causal sliding window (needs ``causal`` — or a cache, whose
+        prefix mask is causal by construction): each position attends only
+        the last ``window`` positions. 0 = unbounded. Not supported by the
+        sequence-parallel impls (ring/ulysses).
       cache: optional decode KV cache ``{"k","v","index"}`` with k/v shaped
         (B, max_len, H, D); when given, S_q is the number of new positions
         (1 for greedy decode), new k/v are written at ``index`` and attention
@@ -195,6 +200,14 @@ def mha_apply(
 
     Returns ``(out, weights|None, cache|None)``.
     """
+    if window and not causal and cache is None:
+        # Same contract as flash_attention and the ring/ulysses branch:
+        # a window without causality (or a cache, whose prefix mask is
+        # causal by construction) would otherwise be silently ignored.
+        raise ValueError(
+            "window requires causal=True (or a decode cache); bidirectional "
+            "local attention is not implemented"
+        )
     dtype = x_q.dtype
     q = _project(params["query"], x_q, dtype)
     if precomputed_kv is not None:
@@ -243,6 +256,16 @@ def mha_apply(
         positions = jnp.arange(max_len)[None, None, None, :]
         q_pos = idx + jnp.arange(x_q.shape[1])[None, None, :, None]
         valid = positions <= q_pos
+        if window:
+            # Sliding window over the cache: only the last `window` filled
+            # positions stay visible (matches the banded training mask).
+            # NOTE this is a masking guarantee, not a memory/compute one:
+            # the cache buffer stays max_len-sized and each step still
+            # scores all slots. A rolling O(window) buffer would change
+            # cache indexing (and RoPE position bookkeeping) and is not
+            # implemented; the structural O(window) win applies to the
+            # flash training/prefill path.
+            valid = jnp.logical_and(valid, positions > q_pos - window)
         mask = valid if mask is None else jnp.logical_and(mask, valid)
         k = k.astype(dtype)
         v = v.astype(dtype)
@@ -263,6 +286,7 @@ def mha_apply(
             q, k, v,
             kv_mask=kv_mask,
             causal=causal,
+            window=window if causal else 0,
             block_q=flash_block_q,
             block_k=flash_block_k,
         )
@@ -274,6 +298,12 @@ def mha_apply(
         # runs under shard_map on the context's mesh with S split over the
         # 'seq' axis (KV chunks ride ICI via ppermute / all_to_all —
         # parallel/ring_attention.py).
+        if window:
+            raise ValueError(
+                "attention window is not supported by the sequence-parallel "
+                "impls (ring/ulysses): the band would cross chunk boundaries "
+                "per hop; use attention_impl='flash' for windowed long-context"
+            )
         from transformer_tpu.parallel.seq_context import (
             current_seq_context,
             seq_parallel_attention,
@@ -297,7 +327,7 @@ def mha_apply(
             # Causality is enforced whether or not a padding mask was provided.
             from transformer_tpu.ops.masks import make_causal_mask
 
-            cmask = make_causal_mask(x_q.shape[1])
+            cmask = make_causal_mask(x_q.shape[1], window=window)
             mask = cmask if mask is None else jnp.logical_and(mask, cmask)
         out, weights = dot_product_attention(q, k, v, mask, return_weights=return_weights)
 

@@ -34,10 +34,16 @@ def make_padding_mask(ids: jax.Array, pad_id: int = PAD_ID) -> jax.Array:
     return allowed[:, None, None, :]
 
 
-def make_causal_mask(seq_len: int) -> jax.Array:
+def make_causal_mask(seq_len: int, window: int = 0) -> jax.Array:
     """(1, 1, S, S) bool, True where query position i may attend key position
-    j<=i (reference ``create_look_ahead_mask``, ``positionalencoding.py:32-34``)."""
+    j<=i (reference ``create_look_ahead_mask``, ``positionalencoding.py:32-34``).
+    ``window > 0`` additionally bounds attention to the last ``window``
+    positions (banded/sliding-window causal mask, Mistral-style)."""
     mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
+    if window:
+        mask = jnp.logical_and(
+            mask, jnp.triu(jnp.ones_like(mask), k=-(window - 1))
+        )
     return mask[None, None, :, :]
 
 
