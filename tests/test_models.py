@@ -269,6 +269,8 @@ class TestKVCacheDecode:
         full_logits, _ = transformer_apply(params, None, tar, cfg_w)
 
         caches = init_decoder_caches(cfg_w, 2, 9)
+        # The cache is a ROLLING buffer: window slots, not max_len.
+        assert caches[0]["k"].shape[1] == 3
         for t in range(8):
             step_logits, caches = transformer_decode_step(
                 params, tar[:, t : t + 1], None, None, caches,
@@ -285,6 +287,34 @@ class TestKVCacheDecode:
             np.asarray(full_logits[:, -1]), np.asarray(unwindowed[:, -1]),
             atol=1e-5,
         )
+
+    def test_rolling_window_composes_with_int8_cache(self):
+        """window × kv_cache_int8: the rolling int8 buffer must track the
+        full-precision full-cache windowed oracle within quantization
+        tolerance."""
+        import dataclasses
+
+        cfg_w = dataclasses.replace(TINY, decoder_only=True, attention_window=3)
+        cfg_wq = dataclasses.replace(cfg_w, kv_cache_int8=True)
+        params = transformer_init(jax.random.PRNGKey(0), cfg_w)
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 8))
+
+        caches = init_decoder_caches(cfg_w, 2, 9)
+        caches_q = init_decoder_caches(cfg_wq, 2, 9)
+        assert caches_q[0]["k"].shape[1] == 3
+        assert caches_q[0]["k"].dtype == jnp.int8
+        for t in range(8):
+            fp_logits, caches = transformer_decode_step(
+                params, tar[:, t : t + 1], None, None, caches,
+                jnp.array(t, jnp.int32), cfg_w,
+            )
+            q_logits, caches_q = transformer_decode_step(
+                params, tar[:, t : t + 1], None, None, caches_q,
+                jnp.array(t, jnp.int32), cfg_wq,
+            )
+            err = float(jnp.max(jnp.abs(fp_logits - q_logits)))
+            spread = float(jnp.max(fp_logits) - jnp.min(fp_logits))
+            assert err < 0.05 * spread, (t, err, spread)
 
     def test_window_rejects_seq_parallel_impls(self):
         import dataclasses

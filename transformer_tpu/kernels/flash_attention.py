@@ -119,8 +119,11 @@ def _visible(cfg: _FlashConfig, i, j):
     causality (and, when set, the sliding window)."""
     vis = j * cfg.block_k <= i * cfg.block_q + cfg.block_q - 1
     if cfg.window:
-        # Band lower edge: the tile's last col must reach the highest row's
-        # window start (row - window + 1).
+        # Band lower edge, conservatively from the q-block's FIRST row
+        # (i*bq): its window start (row - window + 1) is the leftmost in
+        # the tile, so any tile whose last col reaches it may still hold
+        # in-band entries for some row. Using the last row here would skip
+        # tiles that earlier rows still need when window < block_q.
         vis = jnp.logical_and(
             vis, j * cfg.block_k + cfg.block_k - 1 >= i * cfg.block_q - cfg.window + 1
         )

@@ -207,11 +207,13 @@ def init_decoder_caches(
     cfg: ModelConfig, batch_size: int, max_len: int
 ) -> list[dict[str, Any]]:
     """One self-attention KV cache per decoder layer (int8-quantized when
-    ``cfg.kv_cache_int8``)."""
+    ``cfg.kv_cache_int8``; a rolling O(window) buffer when
+    ``cfg.attention_window``)."""
     return [
         init_cache(
             batch_size, max_len, cfg.kv_heads, cfg.head_dim,
             cfg.compute_dtype, quantize=cfg.kv_cache_int8,
+            window=cfg.attention_window,
         )
         for _ in range(cfg.num_layers)
     ]

@@ -185,10 +185,15 @@ def mha_apply(
         prefix mask is causal by construction): each position attends only
         the last ``window`` positions. 0 = unbounded. Not supported by the
         sequence-parallel impls (ring/ulysses).
-      cache: optional decode KV cache ``{"k","v","index"}`` with k/v shaped
-        (B, max_len, H, D); when given, S_q is the number of new positions
-        (1 for greedy decode), new k/v are written at ``index`` and attention
-        runs causally over the filled prefix. Returns the updated cache.
+      cache: optional decode KV cache ``{"k","v","index"}`` from
+        ``init_cache``. Full-length cache (k/v shaped (B, max_len, H, D)):
+        S_q is the number of new positions (1 for greedy decode, >1 for
+        prefill), new k/v are written at ``index`` and attention runs
+        causally over the filled prefix. Rolling cache
+        (``init_cache(window=...)``, k/v shaped (B, min(window, max_len),
+        H, D)): one token per step only, slot ``index % buf_len`` is
+        overwritten, the slot mask is built internally (caller masks are
+        rejected). Returns the updated cache.
       precomputed_kv: optional (k, v) already projected to (B, S_k, H, D) —
         used by cross-attention during decode so the static encoder output is
         projected once, not once per generated token.
@@ -227,7 +232,31 @@ def mha_apply(
 
     if cache is not None:
         idx = cache["index"]
-        max_len = cache["k"].shape[1]
+        buf_len = cache["k"].shape[1]
+        # Rolling window buffer (init_cache(window=...)): the buffer holds
+        # only the last `buf_len <= window` positions and each step writes
+        # slot idx % buf_len — decode HBM and score compute are O(window),
+        # not O(max_len). Attention is permutation-invariant over kv slots,
+        # so slot ORDER never matters, only which slots are valid; RoPE
+        # composes because keys are cached already rotated by their
+        # absolute position.
+        rolling = bool(window) and buf_len <= window
+        if rolling:
+            if x_q.shape[1] != 1:
+                raise ValueError(
+                    "rolling-window cache decodes one token per step; "
+                    f"got s_q={x_q.shape[1]} (prefill feeds tokens through "
+                    "the decode scan one at a time)"
+                )
+            if mask is not None:
+                raise ValueError(
+                    "rolling-window cache builds its own slot mask; a "
+                    "caller mask is indexed by absolute position and "
+                    "cannot compose with rotated slots"
+                )
+            write_pos = idx % buf_len
+        else:
+            write_pos = idx
         if "k_scale" in cache:
             # int8 KV cache (init_cache(quantize=True)): store each new
             # (position, head) row as int8 with its own fp32 scale — the
@@ -238,35 +267,38 @@ def mha_apply(
             kq, ks = _quantize_kv(k)
             vq, vs = _quantize_kv(v)
             cache = {
-                "k": jax.lax.dynamic_update_slice(cache["k"], kq, (0, idx, 0, 0)),
-                "k_scale": jax.lax.dynamic_update_slice(cache["k_scale"], ks, (0, idx, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(cache["v"], vq, (0, idx, 0, 0)),
-                "v_scale": jax.lax.dynamic_update_slice(cache["v_scale"], vs, (0, idx, 0, 0)),
+                "k": jax.lax.dynamic_update_slice(cache["k"], kq, (0, write_pos, 0, 0)),
+                "k_scale": jax.lax.dynamic_update_slice(cache["k_scale"], ks, (0, write_pos, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(cache["v"], vq, (0, write_pos, 0, 0)),
+                "v_scale": jax.lax.dynamic_update_slice(cache["v_scale"], vs, (0, write_pos, 0, 0)),
                 "index": idx + x_q.shape[1],
             }
             k = cache["k"].astype(dtype) * cache["k_scale"].astype(dtype)
             v = cache["v"].astype(dtype) * cache["v_scale"].astype(dtype)
         else:
-            k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
+            k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, write_pos, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, write_pos, 0, 0))
             cache = {"k": k, "v": v, "index": idx + x_q.shape[1]}
-        # Causal decode mask over the cache buffer: new query at absolute
-        # position idx+i may attend keys at positions <= idx+i (prefill with
-        # s_q > 1 stays causal), combined with any caller-provided mask.
-        positions = jnp.arange(max_len)[None, None, None, :]
-        q_pos = idx + jnp.arange(x_q.shape[1])[None, None, :, None]
-        valid = positions <= q_pos
-        if window:
-            # Sliding window over the cache: only the last `window` filled
-            # positions stay visible (matches the banded training mask).
-            # NOTE this is a masking guarantee, not a memory/compute one:
-            # the cache buffer stays max_len-sized and each step still
-            # scores all slots. A rolling O(window) buffer would change
-            # cache indexing (and RoPE position bookkeeping) and is not
-            # implemented; the structural O(window) win applies to the
-            # flash training/prefill path.
-            valid = jnp.logical_and(valid, positions > q_pos - window)
-        mask = valid if mask is None else jnp.logical_and(mask, valid)
+        if rolling:
+            # Which slots hold a REAL (already-written) position: all of
+            # them once idx wraps, else slots <= idx. Every held position
+            # is inside the band by construction (the newest write evicted
+            # the only out-of-band one).
+            slots = jnp.arange(buf_len)[None, None, None, :]
+            mask = jnp.logical_or(slots <= idx, idx >= buf_len)
+        else:
+            # Causal decode mask over the cache buffer: new query at
+            # absolute position idx+i may attend keys at positions <= idx+i
+            # (prefill with s_q > 1 stays causal), combined with any
+            # caller-provided mask.
+            positions = jnp.arange(buf_len)[None, None, None, :]
+            q_pos = idx + jnp.arange(x_q.shape[1])[None, None, :, None]
+            valid = positions <= q_pos
+            if window:
+                # Sliding window over a FULL-LENGTH cache (window set but
+                # the cache was built without it): mask the band only.
+                valid = jnp.logical_and(valid, positions > q_pos - window)
+            mask = valid if mask is None else jnp.logical_and(mask, valid)
         k = k.astype(dtype)
         v = v.astype(dtype)
 
@@ -286,7 +318,9 @@ def mha_apply(
             q, k, v,
             kv_mask=kv_mask,
             causal=causal,
-            window=window if causal else 0,
+            # The top-of-function guard rejects window without causal on
+            # this (cache-free) path, so window>0 implies causal here.
+            window=window,
             block_q=flash_block_q,
             block_k=flash_block_k,
         )
@@ -353,6 +387,7 @@ def init_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
     quantize: bool = False,
+    window: int = 0,
 ) -> dict[str, Any]:
     """Fresh decode cache. The reference instead re-runs the full decoder over
     a concat-grown buffer every step (``train.py:109-118``) — a recompile bomb
@@ -362,8 +397,15 @@ def init_cache(
     ``quantize=True`` stores k/v as int8 with one fp32 scale per
     (position, head) row (``ModelConfig.kv_cache_int8``): the cache — the
     HBM bottleneck of long-context serving — shrinks ~2x vs bf16 storage
-    (~4x vs fp32) plus D/4 scale overhead; attention dequantizes on read."""
-    shape = (batch_size, max_len, num_heads, head_dim)
+    (~4x vs fp32) plus D/4 scale overhead; attention dequantizes on read.
+
+    ``window > 0`` (``ModelConfig.attention_window``) allocates a ROLLING
+    buffer of only min(window, max_len) slots: each decode step overwrites
+    slot ``index % buf_len``, so windowed decode pays O(window) HBM and
+    score compute regardless of context length. Composes with
+    ``quantize``."""
+    buf_len = min(window, max_len) if window else max_len
+    shape = (batch_size, buf_len, num_heads, head_dim)
     if quantize:
         return {
             "k": jnp.zeros(shape, dtype=jnp.int8),
