@@ -492,6 +492,60 @@ class TestCheckpointAveraging:
             average_checkpoints(mgr, state, [])
 
 
+class TestAdamW:
+    def test_overfit_one_batch(self):
+        import dataclasses
+
+        tc = dataclasses.replace(
+            TCFG, optimizer="adamw", weight_decay=0.01, warmup_steps=20
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        step = jax.jit(make_train_step(TINY, tc))
+        r = np.random.default_rng(0)
+        src = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        tgt = jnp.asarray(r.integers(1, 28, (4, 8)), jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        first = last = None
+        for _ in range(120):
+            state, m = step(state, src, tgt, rng)
+            if first is None:
+                first = float(m["loss"])
+            last = float(m["loss"])
+        assert last < 0.5 * first, (first, last)
+
+    def test_decay_hits_matrices_not_vectors(self):
+        """With zero gradients, adamw's update is pure decay: matrices
+        shrink, vectors (biases, layernorm params) stay untouched."""
+        import dataclasses
+
+        from transformer_tpu.train.state import make_optimizer
+
+        tc = dataclasses.replace(
+            TCFG, optimizer="adamw", weight_decay=0.1, warmup_steps=1
+        )
+        state = create_train_state(jax.random.PRNGKey(0), TINY, tc)
+        tx = make_optimizer(TINY, tc)
+        zero_g = jax.tree.map(jnp.zeros_like, state.params)
+        opt_state = tx.init(state.params)
+        # A few steps past warmup so the schedule LR is nonzero.
+        updates = None
+        for _ in range(3):
+            updates, opt_state = tx.update(zero_g, opt_state, state.params)
+        for path, u in jax.tree_util.tree_flatten_with_path(updates)[0]:
+            name = "/".join(str(getattr(e, "key", e)) for e in path)
+            # Exempt by NAME (qkv biases are 2-D), not rank.
+            if np.asarray(u).ndim >= 2 and not name.endswith("bias"):
+                assert float(jnp.max(jnp.abs(u))) > 0.0, name
+            else:
+                np.testing.assert_array_equal(np.asarray(u), 0.0, err_msg=name)
+
+    def test_decay_requires_adamw(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="weight_decay"):
+            dataclasses.replace(TCFG, weight_decay=0.1)
+
+
 class TestAdafactor:
     def test_overfit_one_batch(self):
         import dataclasses

@@ -101,9 +101,13 @@ def define_flags() -> None:
                       "CE normalization ('batch' = reference rule)")
     flags.DEFINE_float("max_grad_norm", 0.0, "global-norm gradient clip (0 = off)")
     flags.DEFINE_enum(
-        "optimizer", "adam", ["adam", "adafactor"],
+        "optimizer", "adam", ["adam", "adafactor", "adamw"],
         "adam = reference optimizer; adafactor = factored second moments "
-        "(far less optimizer-state memory for big models)")
+        "(far less optimizer-state memory for big models); adamw = "
+        "decoupled weight decay on matrices (--weight_decay)")
+    flags.DEFINE_float(
+        "weight_decay", 0.0,
+        "adamw decoupled weight decay (vectors — biases/layernorms — exempt)")
     flags.DEFINE_boolean("tie_embeddings", False, "share src/tgt embedding tables")
     flags.DEFINE_boolean("tie_output", False, "tie output projection to embedding")
     flags.DEFINE_enum("norm_scheme", "post", ["post", "pre"], "residual LayerNorm wiring")
@@ -263,6 +267,7 @@ def flags_to_train_config() -> TrainConfig:
         loss_normalization=FLAGS.loss_normalization,
         max_grad_norm=FLAGS.max_grad_norm,
         optimizer=FLAGS.optimizer,
+        weight_decay=FLAGS.weight_decay,
         buffer_size=FLAGS.buffer_size,
         max_ckpt_keep=FLAGS.max_ckpt_keep,
         ckpt_path=FLAGS.ckpt_path,

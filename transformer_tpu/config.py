@@ -201,7 +201,10 @@ class TrainConfig:
     # "adafactor": factored second moments — O(d_in + d_out) optimizer state
     # per matrix instead of Adam's 2x params, the standard memory lever for
     # big-model training.
-    optimizer: str = "adam"  # "adam" | "adafactor"
+    # "adamw": decoupled weight decay (``weight_decay``) on matrices only
+    # (vectors — biases, layernorms — are exempt).
+    optimizer: str = "adam"  # "adam" | "adafactor" | "adamw"
+    weight_decay: float = 0.0  # adamw only
     label_smoothing: float = 0.0  # BASELINE.json configs[2] uses > 0
     # "tokens": mean CE over non-pad tokens (the sane default).
     # "batch": sum of per-token CE divided by global batch size — the
@@ -252,9 +255,15 @@ class TrainConfig:
             raise ValueError(
                 f"loss_normalization must be 'tokens' or 'batch', got {self.loss_normalization!r}"
             )
-        if self.optimizer not in ("adam", "adafactor"):
+        if self.optimizer not in ("adam", "adafactor", "adamw"):
             raise ValueError(
-                f"optimizer must be 'adam' or 'adafactor', got {self.optimizer!r}"
+                "optimizer must be 'adam', 'adafactor' or 'adamw', got "
+                f"{self.optimizer!r}"
+            )
+        if self.weight_decay and self.optimizer != "adamw":
+            raise ValueError(
+                "weight_decay > 0 requires optimizer='adamw' (adam/adafactor "
+                "would silently ignore it)"
             )
         if self.lr_schedule not in ("noam", "cosine", "constant"):
             raise ValueError(

@@ -56,6 +56,27 @@ def make_optimizer(model_cfg: ModelConfig, train_cfg: TrainConfig) -> optax.Grad
     schedule = make_lr_schedule(model_cfg, train_cfg)
     if train_cfg.optimizer == "adafactor":
         tx = optax.adafactor(learning_rate=schedule)
+    elif train_cfg.optimizer == "adamw":
+        # Decoupled weight decay (Loshchilov & Hutter). Biases and layernorm
+        # params are exempt — decaying them hurts and no modern recipe does
+        # it. The mask keys on the leaf NAME, not rank: the pre-split qkv
+        # biases are 2-D (H, head_dim) and must still be exempt.
+        def _decay_mask(params):
+            def keep(path, p):
+                last = path[-1]
+                name = str(getattr(last, "key", getattr(last, "name", last)))
+                return p.ndim >= 2 and name != "bias"
+
+            return jax.tree_util.tree_map_with_path(keep, params)
+
+        tx = optax.adamw(
+            learning_rate=schedule,
+            b1=train_cfg.adam_beta1,
+            b2=train_cfg.adam_beta2,
+            eps=train_cfg.adam_epsilon,
+            weight_decay=train_cfg.weight_decay,
+            mask=_decay_mask,
+        )
     else:
         tx = optax.adam(
             learning_rate=schedule,
