@@ -90,6 +90,7 @@ def _configs():
 def bench_config(
     name: str, n_steps: int = 20, mode: str = "full", profile_dir: str = "",
     loss_chunks: int = 1, batch_override: int = 0, seq_override: int = 0,
+    flash_block: int = 0,
 ) -> dict:
     """One measurement. ``mode`` attributes step time without trace tooling:
 
@@ -146,6 +147,13 @@ def bench_config(
         )
     if loss_chunks > 1:
         train_cfg = dataclasses.replace(train_cfg, loss_chunks=loss_chunks)
+    if flash_block:
+        # Flash-kernel tile sweep (long4k): the 128 default was chosen for
+        # VMEM safety, not measured; bigger k-tiles amortize the per-tile
+        # loop overhead at 4096 if they fit.
+        model_cfg = dataclasses.replace(
+            model_cfg, flash_block_q=flash_block, flash_block_k=flash_block
+        )
     if mode == "smallvocab":
         model_cfg = dataclasses.replace(model_cfg, target_vocab_size=2048)
     dev = jax.devices()[0]
@@ -239,6 +247,7 @@ def bench_config(
         (f" [{mode}]" if mode != "full" else "")
         + (f" [chunks={loss_chunks}]" if loss_chunks > 1 else "")
         + (f" [b{batch}xs{seq}]" if batch_override or seq_override else "")
+        + (f" [fb{flash_block}]" if flash_block else "")
     )
     return {
         "metric": f"{name} train throughput" + tag,
@@ -372,6 +381,10 @@ def main() -> None:
         "--seq", type=int, default=0,
         help="override the config's sequence length (0 = keep)",
     )
+    ap.add_argument(
+        "--flash_block", type=int, default=0,
+        help="override flash_block_q/k (flash-kernel tile sweep; 0 = keep)",
+    )
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
@@ -395,7 +408,8 @@ def main() -> None:
                      "--configs", name, "--modes", mode,
                      "--profile_dir", args.profile_dir,
                      "--loss_chunks", str(args.loss_chunks),
-                     "--batch", str(args.batch), "--seq", str(args.seq)],
+                     "--batch", str(args.batch), "--seq", str(args.seq),
+                     "--flash_block", str(args.flash_block)],
                     check=False,
                 )
         return
@@ -409,6 +423,7 @@ def main() -> None:
                     name, args.steps, mode, args.profile_dir,
                     loss_chunks=args.loss_chunks,
                     batch_override=args.batch, seq_override=args.seq,
+                    flash_block=args.flash_block,
                 )
             ),
             flush=True,

@@ -74,6 +74,8 @@ extra_metric() {
     decode|decodeq8) echo "base decode throughput [$1]" ;;
     ldecode) echo "long4k decode throughput [decode]" ;;
     ldecodeq8) echo "long4k decode throughput [decodeq8]" ;;
+    fb256) echo "long4k train throughput [fb256]" ;;
+    fb512) echo "long4k train throughput [fb512]" ;;
     *) echo "base train throughput [$1]" ;;
   esac
 }
@@ -121,6 +123,10 @@ missing_extras() {
     || out="$out,ldecode"
   grep -qF '"metric": "long4k decode throughput [decodeq8]", "value"' "$EXTRA" 2>/dev/null \
     || out="$out,ldecodeq8"
+  grep -qF '"metric": "long4k train throughput [fb256]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,fb256"
+  grep -qF '"metric": "long4k train throughput [fb512]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,fb512"
   [ "$(value_count "base train throughput" "$EXTRA")" -ge 2 ] || out="$out,repbase"
   [ "$(value_count "tiny train throughput" "$EXTRA")" -ge 2 ] || out="$out,reptiny"
   echo "${out#,}"
@@ -269,6 +275,13 @@ while :; do
         timeout 2400 python benchmarks/run.py --configs long4k --modes "$M" --steps 3 >>"$EXTRA" 2>>"$ERR"
         rc=$?
         [ "$rc" -ne 0 ] && record_failure "long4k decode throughput [$M]" "$EXTRA" "$rc"
+        ;;
+      fb256|fb512)
+        B=${PICK#fb}
+        log "running extra: long4k flash tile sweep [$PICK]"
+        timeout 2400 python benchmarks/run.py --configs long4k --flash_block "$B" >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "long4k train throughput [$PICK]" "$EXTRA" "$rc"
         ;;
       repbase)
         log "running extra: base repeat row (variance/median)"
