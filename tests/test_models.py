@@ -316,11 +316,11 @@ class TestKVCacheDecode:
             spread = float(jnp.max(fp_logits) - jnp.min(fp_logits))
             assert err < 0.05 * spread, (t, err, spread)
 
-    def test_window_rejects_seq_parallel_impls(self):
+    def test_window_negative_rejected(self):
         import dataclasses
 
         with pytest.raises(ValueError, match="attention_window"):
-            dataclasses.replace(TINY, attention_impl="ring", attention_window=4)
+            dataclasses.replace(TINY, attention_window=-1)
 
     def test_int8_cache_decode_close_to_fp(self):
         """kv_cache_int8: cached decode through the int8 cache must track the

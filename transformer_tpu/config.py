@@ -83,8 +83,10 @@ class ModelConfig:
     # (Mistral-style). Applies to decoder self-attention and decoder-only
     # LMs; encoder self-attention and cross-attention are unaffected.
     # Structural in the flash kernel (out-of-band tiles skipped: per-row
-    # compute O(window), not O(S)); banded mask under xla; honored by the
-    # KV-cache decode path. Not supported with ring/ulysses. 0 = full.
+    # compute O(window), not O(S)); banded mask under xla; rolling O(window)
+    # KV cache at decode; under ring sequence parallelism out-of-band hops
+    # stop the ring early (ICI traffic O(window)); ulysses applies the band
+    # in its per-device flash call. 0 = full attention.
     attention_window: int = 0
     # int8 decode KV cache (ops/attention.py init_cache(quantize=True)):
     # k/v stored int8 with one fp32 scale per (position, head) row,
@@ -113,11 +115,6 @@ class ModelConfig:
         if self.attention_window < 0:
             raise ValueError(
                 f"attention_window must be >= 0, got {self.attention_window}"
-            )
-        if self.attention_window and self.attention_impl in ("ring", "ulysses"):
-            raise ValueError(
-                "attention_window is not supported with sequence-parallel "
-                "attention (ring/ulysses); use attention_impl='flash'"
             )
         if self.position_scheme not in ("sinusoidal", "rope"):
             raise ValueError(

@@ -60,11 +60,14 @@ class _FlashConfig:
     # INDEX MAPS — kv is never materialized at the full head count, so HBM kv
     # traffic stays at the H_kv rate (the whole point of GQA).
     num_kv_heads: int = 0  # 0 = same as num_heads (plain MHA)
-    # Causal sliding window (Mistral-style local attention): row r attends
-    # cols in [r - window + 1, r]. 0 = unbounded. Structural like causality:
-    # tiles fully OUTSIDE the band (above the diagonal or below the window)
-    # are skipped by _visible, so compute per q-block is O(window), not O(S).
-    window: int = 0
+    # Sliding-window band (Mistral-style local attention): LOCAL row r may
+    # attend LOCAL col c only when c > r - band. None = unbounded. For plain
+    # flash attention band == window (> 0); for ring hops the band is the
+    # window shifted by the hop's static chunk offset (band = W - t·C, any
+    # sign — ring_attention). Structural like causality: tiles fully below
+    # the band are skipped by _visible, so compute per q-block is O(window),
+    # not O(S).
+    band: int | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -114,19 +117,29 @@ def _compiler_params(dimension_semantics: tuple[str, ...]):
         return None
 
 
+def _gated(cfg: _FlashConfig) -> bool:
+    """Whether any structural tile-skip condition applies."""
+    return cfg.causal or cfg.band is not None
+
+
 def _visible(cfg: _FlashConfig, i, j):
     """Whether k-block j has any position visible to q-block i under
-    causality (and, when set, the sliding window)."""
-    vis = j * cfg.block_k <= i * cfg.block_q + cfg.block_q - 1
-    if cfg.window:
+    causality and/or the sliding-window band (call only when ``_gated``)."""
+    conds = []
+    if cfg.causal:
+        conds.append(j * cfg.block_k <= i * cfg.block_q + cfg.block_q - 1)
+    if cfg.band is not None:
         # Band lower edge, conservatively from the q-block's FIRST row
-        # (i*bq): its window start (row - window + 1) is the leftmost in
-        # the tile, so any tile whose last col reaches it may still hold
+        # (i*bq): its band start (row - band + 1) is the leftmost in the
+        # tile, so any tile whose last col reaches it may still hold
         # in-band entries for some row. Using the last row here would skip
-        # tiles that earlier rows still need when window < block_q.
-        vis = jnp.logical_and(
-            vis, j * cfg.block_k + cfg.block_k - 1 >= i * cfg.block_q - cfg.window + 1
+        # tiles that earlier rows still need when band < block_q.
+        conds.append(
+            j * cfg.block_k + cfg.block_k - 1 >= i * cfg.block_q - cfg.band + 1
         )
+    vis = conds[0]
+    for extra in conds[1:]:
+        vis = jnp.logical_and(vis, extra)
     return vis
 
 
@@ -138,16 +151,19 @@ def _tile_bias(cfg: _FlashConfig, s, i, j, mask_ref):
         # blocked lane dim that is neither 128-aligned nor the whole array.
         valid = mask_ref[0, 0] != 0  # (1, block_k)
         s = jnp.where(valid, s, _MASKED)
-    if cfg.causal:
+    if _gated(cfg):
         rows = i * cfg.block_q + jax.lax.broadcasted_iota(
             jnp.int32, (cfg.block_q, cfg.block_k), 0
         )
         cols = j * cfg.block_k + jax.lax.broadcasted_iota(
             jnp.int32, (cfg.block_q, cfg.block_k), 1
         )
-        allowed = cols <= rows
-        if cfg.window:
-            allowed = jnp.logical_and(allowed, cols > rows - cfg.window)
+        allowed = None
+        if cfg.causal:
+            allowed = cols <= rows
+        if cfg.band is not None:
+            in_band = cols > rows - cfg.band
+            allowed = in_band if allowed is None else jnp.logical_and(allowed, in_band)
         s = jnp.where(allowed, s, _MASKED)
     return s
 
@@ -203,7 +219,7 @@ def _fwd_kernel(cfg: _FlashConfig, *refs):
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if cfg.causal:
+    if _gated(cfg):
         pl.when(_visible(cfg, i, j))(_compute)
     else:
         _compute()
@@ -314,7 +330,7 @@ def _ring_step_kernel(cfg: _FlashConfig, *refs):
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if cfg.causal:
+    if _gated(cfg):
         pl.when(_visible(cfg, i, j))(_compute)
     else:
         _compute()
@@ -453,7 +469,7 @@ def _dq_kernel(cfg: _FlashConfig, *refs):
             preferred_element_type=jnp.float32,
         )
 
-    if cfg.causal:
+    if _gated(cfg):
         pl.when(_visible(cfg, i, j))(_compute)
     else:
         _compute()
@@ -505,7 +521,7 @@ def _dkdv_kernel(cfg: _FlashConfig, *refs):
             preferred_element_type=jnp.float32,
         )  # (ds·scale)ᵀ·q -> (bk, D)
 
-    if cfg.causal:
+    if _gated(cfg):
         pl.when(_visible(cfg, i, j))(_compute)
     else:
         _compute()
@@ -727,7 +743,7 @@ def flash_attention(
         scale=d**-0.5,
         interpret=bool(interpret),
         num_kv_heads=h_kv,
-        window=int(window),
+        band=int(window) if window else None,
     )
 
     # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows (kv

@@ -332,12 +332,6 @@ def mha_apply(
         # runs under shard_map on the context's mesh with S split over the
         # 'seq' axis (KV chunks ride ICI via ppermute / all_to_all —
         # parallel/ring_attention.py).
-        if window:
-            raise ValueError(
-                "attention window is not supported by the sequence-parallel "
-                "impls (ring/ulysses): the band would cross chunk boundaries "
-                "per hop; use attention_impl='flash' for windowed long-context"
-            )
         from transformer_tpu.parallel.seq_context import (
             current_seq_context,
             seq_parallel_attention,
@@ -354,7 +348,9 @@ def mha_apply(
         kv_mask = _kv_padding_mask(mask, impl)
         if kv_mask is not None and kv_mask.shape[0] == 1 and q.shape[0] != 1:
             kv_mask = jnp.broadcast_to(kv_mask, (q.shape[0], kv_mask.shape[1]))
-        out = seq_parallel_attention(ctx, impl, q, k, v, kv_mask, causal)
+        out = seq_parallel_attention(
+            ctx, impl, q, k, v, kv_mask, causal, window=window
+        )
         weights = None
     else:
         if causal and cache is None:

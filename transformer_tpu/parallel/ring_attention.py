@@ -62,11 +62,20 @@ class _RingConfig:
     scale: float
     interpret: bool
     num_kv_heads: int = 0  # 0 = same as num_heads (plain MHA)
+    # Sliding window (causal only). The band is STATIC per hop: at hop t the
+    # visiting kv chunk sits exactly t chunks behind the local q chunk
+    # (src = (my - t) mod P), so in local tile coordinates the window
+    # constraint col_global > row_global - W becomes col > row - (W - t·C) —
+    # a static band the kernels skip tiles against. Hops with the whole
+    # chunk below the band are dropped from the ring entirely, so ICI
+    # traffic is O(window), not O(S).
+    window: int = 0
+    chunk: int = 0  # local chunk length C (set when window > 0)
 
-    def flash(self, causal: bool) -> _FlashConfig:
+    def flash(self, causal: bool, band: int | None = None) -> _FlashConfig:
         """Kernel config for one chunk pair; ``causal`` means 'this is the
         diagonal pair' (intra-chunk causality — local coordinates coincide
-        with global ones there)."""
+        with global ones there); ``band`` is the hop's static window band."""
         return _FlashConfig(
             causal=causal,
             has_mask=self.has_mask,
@@ -76,7 +85,23 @@ class _RingConfig:
             scale=self.scale,
             interpret=self.interpret,
             num_kv_heads=self.num_kv_heads,
+            band=band,
         )
+
+    def kept_hops(self) -> int:
+        """How many ring hops can contribute at all under the window: hop t
+        is dead once even its newest position (local col c-1 against local
+        row 0) falls out of the band (W <= t·C - C + 1). Monotonic in t, so
+        the ring simply stops early. Without a window: all P hops."""
+        if not self.window:
+            return self.axis_size
+        t = 0
+        while t < self.axis_size and self.window > t * self.chunk - self.chunk + 1:
+            t += 1
+        return t
+
+    def hop_band(self, t: int) -> int | None:
+        return (self.window - t * self.chunk) if self.window else None
 
 
 def _ring_block(c: int, requested: int) -> int:
@@ -127,10 +152,12 @@ def _ring_fwd_impl(cfg: _RingConfig, q, k, v, kv_mask):
     acc = jnp.zeros((b * h, c, d), jnp.float32)
 
     k_cur, v_cur, mask_cur = k, v, kv_mask
-    for t in range(P_):  # unrolled: XLA overlaps each ppermute with compute
+    hops = cfg.kept_hops()  # < P_ under a window: the ring stops early
+    for t in range(hops):  # unrolled: XLA overlaps each ppermute with compute
         src = (my - t) % P_  # global index of the chunk visiting this step
         kf, vf = _fold(k_cur), _fold(v_cur)
         mt = _tile_mask(mask_cur, cfg.block_k)
+        band = cfg.hop_band(t)  # static per hop (relative offset == t)
 
         def step(fcfg, m, l, acc, kf=kf, vf=vf, mt=mt):
             return flash_ring_step(fcfg, qf, kf, vf, mt, m, l, acc)
@@ -142,15 +169,15 @@ def _ring_fwd_impl(cfg: _RingConfig, q, k, v, kv_mask):
             m, l, acc = jax.lax.switch(
                 branch,
                 [
-                    functools.partial(step, cfg.flash(False)),
-                    functools.partial(step, cfg.flash(True)),
+                    functools.partial(step, cfg.flash(False, band)),
+                    functools.partial(step, cfg.flash(True, band)),
                     lambda m, l, acc: (m, l, acc),
                 ],
                 m, l, acc,
             )
         else:
             m, l, acc = step(cfg.flash(False), m, l, acc)
-        if t + 1 < P_:
+        if t + 1 < hops:
             k_cur = jax.lax.ppermute(k_cur, cfg.axis_name, shift)
             v_cur = jax.lax.ppermute(v_cur, cfg.axis_name, shift)
             if mask_cur is not None:
@@ -191,10 +218,12 @@ def _ring_bwd_rule(cfg, residuals, do):
     dv_cur = jnp.zeros((b * h_kv, c, d), jnp.float32)
     k_cur, v_cur, mask_cur = k, v, kv_mask
 
-    for t in range(P_):
+    hops = cfg.kept_hops()
+    for t in range(hops):
         src = (my - t) % P_
         kf, vf = _fold(k_cur), _fold(v_cur)
         mt = _tile_mask(mask_cur, cfg.block_k)
+        band = cfg.hop_band(t)
 
         def step(fcfg, dq, dk_acc, dv_acc, kf=kf, vf=vf, mt=mt):
             dq_s, dk_s, dv_s = flash_chunk_bwd(
@@ -211,22 +240,36 @@ def _ring_bwd_rule(cfg, residuals, do):
             dq, dk_cur, dv_cur = jax.lax.switch(
                 branch,
                 [
-                    functools.partial(step, cfg.flash(False)),
-                    functools.partial(step, cfg.flash(True)),
+                    functools.partial(step, cfg.flash(False, band)),
+                    functools.partial(step, cfg.flash(True, band)),
                     lambda dq, dk_acc, dv_acc: (dq, dk_acc, dv_acc),
                 ],
                 dq, dk_cur, dv_cur,
             )
         else:
             dq, dk_cur, dv_cur = step(cfg.flash(False), dq, dk_cur, dv_cur)
-        # Rotate EVERY hop (unlike the forward's P-1): after P hops the kv
-        # chunks — and the gradients riding with them — are home again.
-        k_cur = jax.lax.ppermute(k_cur, cfg.axis_name, shift)
-        v_cur = jax.lax.ppermute(v_cur, cfg.axis_name, shift)
-        dk_cur = jax.lax.ppermute(dk_cur, cfg.axis_name, shift)
-        dv_cur = jax.lax.ppermute(dv_cur, cfg.axis_name, shift)
-        if mask_cur is not None:
-            mask_cur = jax.lax.ppermute(mask_cur, cfg.axis_name, shift)
+        # Full ring: rotate EVERY hop (unlike the forward's P-1) — after P
+        # hops the kv chunks, and the gradients riding with them, are home
+        # again. Early-stopped ring (window): skip the last hop's rotation
+        # (its k/v would never be used) and fold ALL remaining displacement
+        # into the single re-home permute below.
+        if t + 1 < hops or hops == P_:
+            k_cur = jax.lax.ppermute(k_cur, cfg.axis_name, shift)
+            v_cur = jax.lax.ppermute(v_cur, cfg.axis_name, shift)
+            dk_cur = jax.lax.ppermute(dk_cur, cfg.axis_name, shift)
+            dv_cur = jax.lax.ppermute(dv_cur, cfg.axis_name, shift)
+            if mask_cur is not None:
+                mask_cur = jax.lax.ppermute(mask_cur, cfg.axis_name, shift)
+
+    if hops < P_:
+        # dk/dv sit hops-1 rotations from the loop; one permute covering
+        # the remaining P - (hops - 1) steps re-homes them (skip the no-op
+        # when that wraps to a full circle).
+        offset = (P_ - (hops - 1)) % P_
+        if offset:
+            rehome = [(i, (i + offset) % P_) for i in range(P_)]
+            dk_cur = jax.lax.ppermute(dk_cur, cfg.axis_name, rehome)
+            dv_cur = jax.lax.ppermute(dv_cur, cfg.axis_name, rehome)
 
     return (
         _unfold(dq, b, h).astype(q.dtype),
@@ -248,6 +291,7 @@ def ring_attention(
     axis_size: int,
     kv_mask: jax.Array | None = None,
     causal: bool = False,
+    window: int = 0,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
@@ -275,6 +319,11 @@ def ring_attention(
       kv_mask: optional (B, C) bool, True where the local key is real.
       causal: structural causal masking across global positions (chunk pairs
         fully above the diagonal skip their kernel launch entirely).
+      window: causal sliding window (requires ``causal``). The hop-t band
+        offset is STATIC (the visiting chunk is always exactly t chunks
+        behind), so the band is a compile-time kernel constraint AND the
+        ring stops after ceil-ish window/C hops — out-of-band chunks are
+        never even ppermuted, making ICI traffic O(window), not O(S).
       block_q, block_k: requested tile sizes; shrunk to TPU-legal divisors
         of the chunk length.
       interpret: run the Pallas kernels in interpret mode (default: off-TPU).
@@ -287,6 +336,8 @@ def ring_attention(
         raise ValueError(
             f"query heads {h} must be a multiple of kv heads {h_kv}"
         )
+    if window and not causal:
+        raise ValueError("ring window requires causal=True")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     cfg = _RingConfig(
@@ -300,6 +351,8 @@ def ring_attention(
         scale=d**-0.5,
         interpret=bool(interpret),
         num_kv_heads=h_kv,
+        window=int(window),
+        chunk=c,
     )
     if kv_mask is not None:
         kv_mask = jnp.broadcast_to(kv_mask, (b, c))
@@ -315,6 +368,7 @@ def ulysses_attention(
     axis_size: int,
     kv_mask: jax.Array | None = None,
     causal: bool = False,
+    window: int = 0,
 ) -> jax.Array:
     """Ulysses-style sequence parallelism: all-to-all from sequence-sharded
     (B, C, H, D) to head-sharded (B, S, H/P, D), full-sequence attention per
@@ -359,7 +413,12 @@ def ulysses_attention(
     )
     from transformer_tpu.kernels.flash_attention import flash_attention
 
-    out = flash_attention(q_full, k_full, v_full, kv_mask=full_kv, causal=causal)
+    # Windowed attention passes straight through: each device holds the FULL
+    # sequence for its head block, so the flash kernel's structural band
+    # applies unchanged.
+    out = flash_attention(
+        q_full, k_full, v_full, kv_mask=full_kv, causal=causal, window=window
+    )
     return heads_to_seq(out)
 
 
@@ -382,9 +441,10 @@ def make_sequence_parallel_attention(
     act = P(bdim, axis, None, None)
     mask_spec = P(bdim, axis)
 
-    def call(q, k, v, kv_mask=None, causal=False):
+    def call(q, k, v, kv_mask=None, causal=False, window=0):
         fn = functools.partial(
-            inner, axis_name=axis, axis_size=axis_size, causal=causal
+            inner, axis_name=axis, axis_size=axis_size, causal=causal,
+            window=window,
         )
         if kv_mask is None:
             sharded = jax.shard_map(

@@ -64,6 +64,7 @@ def seq_parallel_attention(
     v: jax.Array,
     kv_mask: jax.Array | None,
     causal: bool,
+    window: int = 0,
 ) -> jax.Array:
     """Run ring/Ulysses attention over global (B, S, H, D) activations inside
     ``shard_map`` on ``ctx.mesh``: S split on the seq axis, B on the batch
@@ -111,7 +112,9 @@ def seq_parallel_attention(
             reps = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
-    fn = functools.partial(inner, axis_name=ctx.axis, axis_size=sp, causal=causal)
+    fn = functools.partial(
+        inner, axis_name=ctx.axis, axis_size=sp, causal=causal, window=window
+    )
     if kv_mask is None:
         sharded = jax.shard_map(
             lambda q, k, v: fn(q, k, v),
