@@ -1,0 +1,67 @@
+"""Mesh-parallel training in ~40 lines: one sharded train step on a virtual
+8-device CPU mesh (the same code runs unchanged on a TPU slice).
+
+    python examples/mesh_parallel_step.py
+
+Axes are config, not code: change `MeshConfig(data=2, fsdp=2, model=2)` to
+any shape (seq/pipe/expert included — see README "Composition matrix") and
+the same `make_sharded_steps` builds the right program; XLA inserts the
+collectives from the sharding annotations.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from transformer_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from transformer_tpu.parallel import (
+    create_sharded_state,
+    make_mesh,
+    make_sharded_steps,
+    put_batch,
+)
+
+
+def main() -> None:
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    model_cfg = ModelConfig(
+        num_layers=2, d_model=64, num_heads=4, dff=128,
+        input_vocab_size=1000, target_vocab_size=1000, max_position=32,
+        dtype="float32",
+    )
+    train_cfg = TrainConfig(batch_size=16, sequence_length=16, warmup_steps=100)
+
+    # Params/optimizer state are INITIALIZED sharded (no host-side full copy);
+    # the returned shardings drive the jitted step's in/out specs.
+    state, shardings = create_sharded_state(
+        jax.random.PRNGKey(0), model_cfg, train_cfg, mesh
+    )
+    train_step, eval_step = make_sharded_steps(
+        mesh, model_cfg, train_cfg, shardings
+    )
+
+    r = np.random.default_rng(0)
+    src = r.integers(1, 1000, (16, 16), dtype=np.int32)
+    tgt = r.integers(1, 1000, (16, 16), dtype=np.int32)
+    rng = jax.random.PRNGKey(1)
+    for i in range(5):
+        state, metrics = train_step(
+            state, put_batch(src, mesh), put_batch(tgt, mesh), rng
+        )
+        print(f"step {i + 1}: loss {float(metrics['loss']):.4f}")
+    print("param sharding example:",
+          state.params["encoder"]["layers"][0]["ffn"]["in"]["kernel"].sharding)
+
+
+if __name__ == "__main__":
+    main()
