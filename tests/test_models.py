@@ -163,6 +163,39 @@ class TestTransformer:
             float(m_plain["loss"]), float(m_remat["loss"]), rtol=1e-6
         )
 
+    def test_remat_dots_policy_matches_full(self):
+        """remat_policy='dots' (save matmul outputs, recompute elementwise)
+        must produce the same step numerics as the full-recompute policy —
+        the policy is a memory/FLOPs dial, never a math change."""
+        import dataclasses
+
+        from transformer_tpu.config import TrainConfig
+        from transformer_tpu.train import create_train_state, make_train_step
+
+        cfg_full = dataclasses.replace(TINY, dropout_rate=0.0, remat=True)
+        cfg_dots = dataclasses.replace(cfg_full, remat_policy="dots")
+        tcfg = TrainConfig(batch_size=2, sequence_length=8, warmup_steps=10)
+        inp = tokens(jax.random.PRNGKey(1), 40, (2, 7))
+        tar = tokens(jax.random.PRNGKey(2), 48, (2, 7))
+        rng = jax.random.PRNGKey(3)
+
+        s_full = create_train_state(jax.random.PRNGKey(0), cfg_full, tcfg)
+        s_dots = create_train_state(jax.random.PRNGKey(0), cfg_dots, tcfg)
+        s_full, m_full = jax.jit(make_train_step(cfg_full, tcfg))(s_full, inp, tar, rng)
+        s_dots, m_dots = jax.jit(make_train_step(cfg_dots, tcfg))(s_dots, inp, tar, rng)
+        np.testing.assert_allclose(
+            float(m_full["loss"]), float(m_dots["loss"]), rtol=1e-6
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            s_full.params, s_dots.params,
+        )
+
+        with pytest.raises(ValueError, match="remat_policy"):
+            dataclasses.replace(TINY, remat_policy="bogus")
+
     def test_tied_embeddings_share_table(self):
         cfg = ModelConfig(
             num_layers=1, d_model=16, num_heads=2, dff=32,

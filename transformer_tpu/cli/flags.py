@@ -196,6 +196,11 @@ def define_flags() -> None:
         "compute the vocab projection + CE over this many sequence slices so "
         "the full (B,S,V) logits tensor is never materialized (1 = off) — "
         "the memory lever for big-vocab/long-context configs")
+    flags.DEFINE_enum(
+        "remat_policy", "full", ["full", "dots"],
+        "what remat may keep: 'full' recomputes everything (min memory); "
+        "'dots' saves matmul outputs, recomputes only elementwise ops "
+        "(most of the memory win at a fraction of the recompute)")
     flags.DEFINE_integer(
         "attention_window", 0,
         "sliding-window causal self-attention: each position attends only "
@@ -245,6 +250,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         attention_impl=FLAGS.attention_impl,
         attention_window=FLAGS.attention_window,
         remat=FLAGS.remat,
+        remat_policy=FLAGS.remat_policy,
         moe_experts=FLAGS.moe_experts,
         moe_top_k=FLAGS.moe_top_k,
         moe_capacity_factor=FLAGS.moe_capacity_factor,

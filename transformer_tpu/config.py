@@ -78,6 +78,13 @@ class ModelConfig:
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(layers) less activation
     # HBM — the standard lever for long-context configs (BASELINE configs[4]).
     remat: bool = False
+    # What remat may KEEP from the forward pass ("full" = keep nothing,
+    # recompute everything — minimum memory, ~1/3 extra FLOPs; "dots" =
+    # jax.checkpoint_policies.dots_with_no_batch_dims_saveable: save matmul
+    # outputs, recompute only the cheap elementwise/bandwidth-bound ops —
+    # most of the memory win at a fraction of the recompute, usually the
+    # better point on TPUs where MXU FLOPs are the scarce resource).
+    remat_policy: str = "full"  # "full" | "dots"
     # Sliding-window (local) attention for CAUSAL self-attention: each
     # position attends only the last `attention_window` positions
     # (Mistral-style). Applies to decoder self-attention and decoder-only
@@ -112,6 +119,10 @@ class ModelConfig:
             )
         if self.norm_scheme not in ("post", "pre"):
             raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
+            )
         if self.attention_window < 0:
             raise ValueError(
                 f"attention_window must be >= 0, got {self.attention_window}"

@@ -26,6 +26,7 @@ from transformer_tpu.ops.nn import (
     embedding_init,
     layernorm_apply,
     layernorm_init,
+    remat_layer,
 )
 from transformer_tpu.models.encoder import (
     _ffn_sublayer_apply,
@@ -181,7 +182,7 @@ def decoder_apply(
     if cfg.remat and caches is None:
         # Training-time only (decode's KV-cache path gains nothing from
         # recomputation); see cfg.remat docstring.
-        layer_call = jax.checkpoint(layer_call)
+        layer_call = remat_layer(layer_call, cfg)
     for i, layer in enumerate(params["layers"]):
         x, w1, w2, new_cache, aux = layer_call(
             layer, x, enc_out, self_mask, cross_mask, rngs[i + 1],

@@ -28,6 +28,7 @@ from transformer_tpu.ops.nn import (
     embedding_lookup,
     layernorm_apply,
     layernorm_init,
+    remat_layer,
 )
 from transformer_tpu.ops.positional import sinusoidal_positional_encoding
 
@@ -221,7 +222,7 @@ def encoder_apply(
     if cfg.remat:
         # Long-context lever: recompute each layer's activations in the
         # backward pass instead of keeping them live (cfg.remat docstring).
-        layer_call = jax.checkpoint(layer_call)
+        layer_call = remat_layer(layer_call, cfg)
     for i, layer in enumerate(params["layers"]):
         x, w, aux = layer_call(layer, x, mask, rngs[i + 1])
         if w is not None:

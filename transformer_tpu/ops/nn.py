@@ -83,3 +83,16 @@ def dropout(key: jax.Array | None, x: jax.Array, rate: float, deterministic: boo
     keep = 1.0 - rate
     mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def remat_layer(fn, cfg):
+    """Wrap a per-layer apply in ``jax.checkpoint`` under the configured
+    policy (``ModelConfig.remat_policy``): "full" recomputes everything;
+    "dots" saves matmul outputs and recomputes only the elementwise/
+    bandwidth-bound ops (``dots_with_no_batch_dims_saveable``) — the same
+    gradients either way, different memory/recompute point."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    return jax.checkpoint(fn)
