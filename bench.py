@@ -33,18 +33,21 @@ _METRIC = "transformer-base train throughput (6L/512/8H/2048, bf16, batch 64, se
 # printed (BENCH_r02.json: parsed=null). The budget guarantees the one JSON
 # line is always emitted well inside any plausible driver timeout.
 #
-# Tradeoff, chosen deliberately: a healthy first attempt gets ~160 s, which
+# Tradeoff, chosen deliberately: a healthy first attempt gets ~200 s, which
 # covers the measured profile (~20-40 s cold XLA compile + ~1 s of timing
-# loop, r2: base measured at rc=0 well inside this) but would fail a
+# loop, r2: base measured at rc=0 well inside this, plus one optional
+# second compile for the multistep field below) but would fail a
 # pathologically slow backend. That failure is still a PARSEABLE line —
 # recoverable by the judge — whereas exceeding the driver's window repeats
 # the unrecoverable rc=124/parsed=null. Short-and-parseable beats
 # long-and-killed.
-_TOTAL_BUDGET_S = 170.0
+_TOTAL_BUDGET_S = 220.0
 
 
 def _run_inner() -> None:
     """The actual measurement. Runs in a child process (fresh backend)."""
+    _t_start = time.monotonic()
+
     import jax
     import numpy as np
 
@@ -106,16 +109,50 @@ def _run_inner() -> None:
         f"({n_params / 1e6:.1f}M params)",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": _METRIC,
-                "value": round(value, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": None,
-            }
-        )
-    )
+    result = {
+        "metric": _METRIC,
+        "value": round(value, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+    }
+
+    # Production dispatch path (TrainConfig.steps_per_dispatch): the same 20
+    # optimizer steps inside ONE jitted scan with distinct stacked batches —
+    # what --steps_per_dispatch buys a real run by amortizing per-step host
+    # dispatch. Reported as an extra field (the headline stays the plain
+    # per-step dispatch number); skipped, never fatal, if the budget is
+    # tight or the second compile fails.
+    try:
+        if time.monotonic() - _t_start < 100.0:
+            from transformer_tpu.train.trainer import make_multistep_train_step
+
+            multi = jax.jit(
+                make_multistep_train_step(make_train_step(model_cfg, train_cfg)),
+                donate_argnums=(0,),
+            )
+            srcs = jax.device_put(
+                r.integers(1, 32000, (n_steps, batch, seq), dtype=np.int32)
+            )
+            tgts = jax.device_put(
+                r.integers(1, 32000, (n_steps, batch, seq), dtype=np.int32)
+            )
+            state, metrics = multi(state, srcs, tgts, rng)  # compile + warm
+            float(metrics["loss"])
+            t0 = time.perf_counter()
+            state, metrics = multi(state, srcs, tgts, rng)
+            float(metrics["loss"])
+            ms_dt = time.perf_counter() - t0
+            result["multistep_tokens_per_sec"] = round(
+                tokens_per_step * n_steps / ms_dt, 1
+            )
+            result["multistep_note"] = (
+                f"steps_per_dispatch={n_steps}: one dispatch, {n_steps} "
+                "optimizer steps on distinct stacked batches"
+            )
+    except Exception as e:  # noqa: BLE001 — optional field only
+        print(f"multistep field skipped: {e!r}", file=sys.stderr)
+
+    print(json.dumps(result))
 
 
 def _looks_retryable(text: str) -> bool:
