@@ -95,3 +95,50 @@ def test_presets_match_benchmark_configs():
         if model_cfg.decoder_only:
             assert preset.get("attention_impl") == model_cfg.attention_impl, name
             assert preset.get("sequence_length") == seq, name
+
+
+def test_serve_loop_end_to_end(tmp_path):
+    """cli.serve: build a tiny export, pipe mixed raw/JSON/bad requests
+    through the loop, get one JSONL response per request with the loop
+    surviving the malformed one."""
+    import json
+
+    build = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.models import transformer_init
+from transformer_tpu.train.checkpoint import export_params
+from transformer_tpu.data.tokenizer import SubwordTokenizer
+tok = SubwordTokenizer.build_from_corpus(["ab cd ef gh"] * 3, target_vocab_size=270)
+tok.save(r"{tmp_path}/vocab.subwords")
+cfg = ModelConfig(num_layers=1, d_model=16, num_heads=2, dff=32,
+                  input_vocab_size=tok.model_vocab_size,
+                  target_vocab_size=tok.model_vocab_size,
+                  max_position=32, dtype="float32", dropout_rate=0.0)
+export_params(transformer_init(jax.random.PRNGKey(0), cfg), cfg, r"{tmp_path}/model")
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", build],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    requests = 'ab cd\n{"src": "ef gh", "beam": 2}\n{"nope": 1}\n'
+    out = subprocess.run(
+        [sys.executable, "-m", "transformer_tpu.cli.serve",
+         "--platform=cpu",
+         f"--export_path={tmp_path}/model",
+         f"--src_vocab_file={tmp_path}/vocab.subwords",
+         f"--tgt_vocab_file={tmp_path}/vocab.subwords",
+         "--max_len=4"],
+        input=requests, capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert len(lines) == 3, out.stdout
+    assert "translation" in lines[0]
+    assert "translation" in lines[1]
+    assert "error" in lines[2]

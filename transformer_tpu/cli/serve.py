@@ -1,0 +1,115 @@
+"""Persistent serving loop: JSONL requests on stdin, JSONL responses on stdout.
+
+    python -m transformer_tpu.cli.serve --export_path=model \
+        --src_vocab_file=src.subwords --tgt_vocab_file=tgt.subwords
+
+Each input line is either a JSON object or a raw sentence:
+
+    {"src": "he goes to school"}            seq2seq translation
+    {"src": "...", "beam": 4}               per-request beam override
+    {"prompt": "...", "max_new": 32}        decoder-only LM continuation
+    he goes to school                       raw line == {"src": ...}
+
+One response line per request: {"translation": ...} / {"continuation": ...},
+or {"error": ...} for malformed requests (the loop never dies on one bad
+line). The point of the loop (vs one `cli.translate` invocation per
+request) is compile amortization: the decode program caches per
+(batch, width) bucket, so request N hits the cache request 1 paid for —
+the right shape for a long-lived TPU serving process.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from absl import app, flags, logging
+
+FLAGS = flags.FLAGS
+
+
+def define_serve_flags() -> None:
+    from transformer_tpu.cli.translate import define_export_serving_flags
+
+    define_export_serving_flags()
+
+
+def _handle(req: dict, params, model_cfg, src_tok, tgt_tok) -> dict:
+    from transformer_tpu.train.decode import generate, translate
+
+    if "src" in req:
+        if model_cfg.decoder_only:
+            return {"error": "decoder-only export serves 'prompt', not 'src'"}
+        out = translate(
+            params, model_cfg, src_tok, tgt_tok, [str(req["src"])],
+            max_len=int(req.get("max_len", FLAGS.max_len)),
+            beam_size=int(req.get("beam", FLAGS.beam)),
+        )
+        return {"translation": out[0]}
+    if "prompt" in req:
+        if not model_cfg.decoder_only:
+            return {"error": "seq2seq export serves 'src', not 'prompt'"}
+        out = generate(
+            params, model_cfg, tgt_tok, [str(req["prompt"])],
+            max_new=int(req.get("max_new", FLAGS.max_len)),
+            temperature=float(req.get("temperature", 0.0)),
+            top_k=int(req.get("top_k", 0)),
+            top_p=float(req.get("top_p", 1.0)),
+        )
+        return {"continuation": out[0]}
+    return {"error": "request needs 'src' (seq2seq) or 'prompt' (LM)"}
+
+
+def main(argv) -> None:
+    del argv
+    if FLAGS.platform:
+        import jax
+
+        jax.config.update("jax_platforms", FLAGS.platform)
+
+    from transformer_tpu.cli.translate import load_export
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+    params, model_cfg = load_export(
+        FLAGS.export_path, kv_cache_int8=FLAGS.kv_cache_int8
+    )
+    if model_cfg.decoder_only:
+        src_tok = tgt_tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+    else:
+        src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
+        tgt_tok = (
+            src_tok
+            if FLAGS.tgt_vocab_file == FLAGS.src_vocab_file
+            else SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+        )
+    logging.info("serving %s from %s; one JSONL request per stdin line",
+                 "LM" if model_cfg.decoder_only else "seq2seq",
+                 FLAGS.export_path)
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            if line.startswith("{"):
+                req = json.loads(line)
+            else:
+                # Raw-line convenience maps to whichever request kind this
+                # export actually serves.
+                key = "prompt" if model_cfg.decoder_only else "src"
+                req = {key: line}
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+            resp = _handle(req, params, model_cfg, src_tok, tgt_tok)
+        except Exception as e:  # noqa: BLE001 — one bad line must not kill the loop
+            resp = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(resp), flush=True)
+
+
+def run() -> None:
+    define_serve_flags()
+    app.run(main)
+
+
+if __name__ == "__main__":
+    run()

@@ -19,13 +19,24 @@ from absl import app, flags, logging
 FLAGS = flags.FLAGS
 
 
-def define_translate_flags() -> None:
+def define_export_serving_flags() -> None:
+    """The flags every export-consuming CLI shares (translate, serve) —
+    one source of truth so the serving surfaces cannot drift."""
     flags.DEFINE_string("export_path", "model", "directory written by export_params")
     flags.DEFINE_string("src_vocab_file", "src_vocab.subwords", "source subword vocab")
     flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords", "target subword vocab")
-    flags.DEFINE_string("sentences", "", "';'-separated sentences (default: stdin lines)")
-    flags.DEFINE_integer("max_len", 64, "max generated tokens per sentence")
+    flags.DEFINE_integer("max_len", 64, "max generated tokens per request")
     flags.DEFINE_integer("beam", 1, "beam size (1 = greedy)")
+    flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
+    flags.DEFINE_boolean(
+        "kv_cache_int8", False,
+        "decode with an int8-quantized KV cache (~2-4x less cache HBM; "
+        "serving-time choice, independent of the export)")
+
+
+def define_translate_flags() -> None:
+    define_export_serving_flags()
+    flags.DEFINE_string("sentences", "", "';'-separated sentences (default: stdin lines)")
     flags.DEFINE_string(
         "attention_out", "",
         "dump per-layer attention maps to this .npz: a teacher-forced "
@@ -33,11 +44,6 @@ def define_translate_flags() -> None:
         "and decoder self/cross maps per sentence — the reference's "
         "attention_weights return (Transformer.py:30-32) as a servable "
         "artifact ('' = off)")
-    flags.DEFINE_string("platform", "", "force a jax platform (e.g. 'cpu') before first use")
-    flags.DEFINE_boolean(
-        "kv_cache_int8", False,
-        "decode with an int8-quantized KV cache (~2-4x less cache HBM; "
-        "serving-time choice, independent of the export)")
 
 
 def load_export(export_path: str, kv_cache_int8: bool = False):
