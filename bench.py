@@ -27,6 +27,16 @@ import time
 
 _INNER_ENV = "_TRANSFORMER_TPU_BENCH_INNER"
 _METRIC = "transformer-base train throughput (6L/512/8H/2048, bf16, batch 64, seq 64)"
+# Banked-measurement stores. bench.py appends its own successful base rows to
+# bench_rows.jsonl and, on a relay outage, falls back to the newest banked
+# TPU base row (marked stale) instead of emitting value:null — a relay that
+# is down during the driver's bench window must not erase a number measured
+# an hour earlier in the same round. The watchdog's repeat-base rows land in
+# bench_extras.jsonl (watch_and_run.sh $EXTRA), so the fallback scans both.
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+_ROWS_FILE = os.path.join(_REPO_DIR, "bench_rows.jsonl")
+_BANK_FILES = (_ROWS_FILE, os.path.join(_REPO_DIR, "bench_extras.jsonl"))
+_BANK_METRIC = "base train throughput"
 # HARD total wall-clock budget for the whole script (attempts + sleeps +
 # child timeouts). Round 2's retry ladder could run ~54 minutes and the
 # driver killed the process (rc=124) before the structured failure line was
@@ -114,6 +124,7 @@ def _run_inner() -> None:
         "value": round(value, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": None,
+        "device": f"{dev.platform}:{dev.device_kind}",
     }
 
     # Production dispatch path (TrainConfig.steps_per_dispatch): the same 20
@@ -153,6 +164,66 @@ def _run_inner() -> None:
         print(f"multistep field skipped: {e!r}", file=sys.stderr)
 
     print(json.dumps(result))
+
+
+def _bank_success(stdout: str) -> None:
+    """Append the fresh base measurement to the shared banked-rows file.
+
+    Stored under the short watchdog-style metric name so the staleness
+    fallback (and BASELINE.md bookkeeping) has one place to look. Banking is
+    best-effort: a read-only disk must not turn a successful bench into rc=1.
+    """
+    try:
+        row = json.loads(stdout.strip().splitlines()[-1])
+        banked = {
+            "metric": _BANK_METRIC,
+            "value": row["value"],
+            "unit": row["unit"],
+            "vs_baseline": None,
+            "device": row.get("device", ""),
+            "source": "bench.py",
+            "ts": round(time.time(), 1),
+        }
+        if "multistep_tokens_per_sec" in row:
+            banked["multistep_tokens_per_sec"] = row["multistep_tokens_per_sec"]
+        with open(_ROWS_FILE, "a") as f:
+            f.write(json.dumps(banked) + "\n")
+    except Exception as e:  # noqa: BLE001 — bookkeeping only
+        print(f"banking skipped: {e!r}", file=sys.stderr)
+
+
+def _latest_banked_base() -> tuple[dict, str] | None:
+    """Newest banked base-config TPU row with a real value, plus its file.
+
+    Rows without a ``device`` containing "tpu" are skipped: a CPU-fallback
+    measurement must never be served as a stale tokens/sec/chip number.
+    "Newest" is by the ``ts`` field bench.py stamps on its banked rows;
+    rows without one (watchdog/seeded rows) rank as ts=0 and fall back to
+    scan order, which is append order within each file.
+    """
+    best, best_path, best_ts = None, "", -1.0
+    for path in _BANK_FILES:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if (
+                row.get("metric") == _BANK_METRIC
+                and row.get("value")
+                and "tpu" in row.get("device", "").lower()
+            ):
+                ts = float(row.get("ts", 0.0))
+                if ts >= best_ts:
+                    best, best_path, best_ts = row, path, ts
+    if best is None:
+        return None
+    return best, best_path
 
 
 def _looks_retryable(text: str) -> bool:
@@ -199,6 +270,11 @@ def main() -> None:
     deadline = time.monotonic() + _TOTAL_BUDGET_S
     last_err = ""
     attempt = 0
+    # Only infrastructure failures (relay down, tunnel hang, UNAVAILABLE)
+    # may fall back to a stale banked row. A deterministic error means the
+    # benchmark itself is broken — serving an old number with rc=0 would
+    # mask a real regression, so that path stays value:null + rc=1.
+    infra_failure = True
     while True:
         remaining = deadline - time.monotonic()
         if remaining < 30:  # not enough left for a useful attempt
@@ -234,14 +310,35 @@ def main() -> None:
         sys.stderr.write(proc.stderr)
         if proc.returncode == 0 and '"value"' in proc.stdout:
             sys.stdout.write(proc.stdout)
+            _bank_success(proc.stdout)
             return
         last_err = (proc.stderr or "") + (proc.stdout or "")
         if not _looks_retryable(last_err):
+            infra_failure = False
             break  # deterministic failure: retrying would just burn time
         time.sleep(min(5.0, max(deadline - time.monotonic(), 0.0)))
 
-    # Final failure: one structured JSON line, not a traceback.
+    # Final failure. Prefer the newest banked base row (clearly marked stale)
+    # over value:null: a dead relay during the bench window must not erase a
+    # number measured earlier in the round (round 3 lost its signal this way).
     tail = "\n".join(last_err.strip().splitlines()[-5:])
+    banked = _latest_banked_base() if infra_failure else None
+    if banked is not None:
+        row, path = banked
+        print(
+            json.dumps(
+                {
+                    "metric": _METRIC,
+                    "value": row["value"],
+                    "unit": row.get("unit", "tokens/sec/chip"),
+                    "vs_baseline": None,
+                    "stale": True,
+                    "stale_reason": tail or "benchmark subprocess produced no output",
+                    "stale_source": f"{os.path.basename(path)} (newest banked base row)",
+                }
+            )
+        )
+        return  # rc=0: the line carries a real (if stale) measurement
     print(
         json.dumps(
             {

@@ -183,8 +183,12 @@ def mha_apply(
       causal: enforce causality; ANDed with any provided ``mask``.
       window: causal sliding window (needs ``causal`` — or a cache, whose
         prefix mask is causal by construction): each position attends only
-        the last ``window`` positions. 0 = unbounded. Not supported by the
-        sequence-parallel impls (ring/ulysses).
+        the last ``window`` positions. 0 = unbounded. Supported on every
+        impl: banded mask under "xla", static band-tile skip under "flash",
+        per-hop band with early ring stop under "ring", and a band in the
+        per-device flash call under "ulysses"
+        (tests/test_sequence_parallel.py::test_window pins the parallel
+        impls against the single-device oracle).
       cache: optional decode KV cache ``{"k","v","index"}`` from
         ``init_cache``. Full-length cache (k/v shaped (B, max_len, H, D)):
         S_q is the number of new positions (1 for greedy decode, >1 for
@@ -239,8 +243,12 @@ def mha_apply(
         # not O(max_len). Attention is permutation-invariant over kv slots,
         # so slot ORDER never matters, only which slots are valid; RoPE
         # composes because keys are cached already rotated by their
-        # absolute position.
-        rolling = bool(window) and buf_len <= window
+        # absolute position. Rolling-ness is carried EXPLICITLY by the
+        # cache (the "rolling" key init_cache stores when built with a
+        # window) — key presence is static pytree structure, so the branch
+        # stays trace-time. Inferring it from buffer size would misclassify
+        # a full-length cache as rolling whenever max_len <= window.
+        rolling = "rolling" in cache
         if rolling:
             if x_q.shape[1] != 1:
                 raise ValueError(
@@ -266,19 +274,22 @@ def mha_apply(
             # model dtype; the win is memory, not FLOPs).
             kq, ks = _quantize_kv(k)
             vq, vs = _quantize_kv(v)
-            cache = {
+            new_cache = {
                 "k": jax.lax.dynamic_update_slice(cache["k"], kq, (0, write_pos, 0, 0)),
                 "k_scale": jax.lax.dynamic_update_slice(cache["k_scale"], ks, (0, write_pos, 0, 0)),
                 "v": jax.lax.dynamic_update_slice(cache["v"], vq, (0, write_pos, 0, 0)),
                 "v_scale": jax.lax.dynamic_update_slice(cache["v_scale"], vs, (0, write_pos, 0, 0)),
                 "index": idx + x_q.shape[1],
             }
-            k = cache["k"].astype(dtype) * cache["k_scale"].astype(dtype)
-            v = cache["v"].astype(dtype) * cache["v_scale"].astype(dtype)
+            k = new_cache["k"].astype(dtype) * new_cache["k_scale"].astype(dtype)
+            v = new_cache["v"].astype(dtype) * new_cache["v_scale"].astype(dtype)
         else:
             k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, write_pos, 0, 0))
             v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, write_pos, 0, 0))
-            cache = {"k": k, "v": v, "index": idx + x_q.shape[1]}
+            new_cache = {"k": k, "v": v, "index": idx + x_q.shape[1]}
+        if rolling:
+            new_cache["rolling"] = cache["rolling"]
+        cache = new_cache
         if rolling:
             # Which slots hold a REAL (already-written) position: all of
             # them once idx wraps, else slots <= idx. Every held position
@@ -398,20 +409,27 @@ def init_cache(
     ``window > 0`` (``ModelConfig.attention_window``) allocates a ROLLING
     buffer of only min(window, max_len) slots: each decode step overwrites
     slot ``index % buf_len``, so windowed decode pays O(window) HBM and
-    score compute regardless of context length. Composes with
-    ``quantize``."""
+    score compute regardless of context length. Composes with ``quantize``.
+    Rolling caches carry a ``"rolling"`` sentinel key — its PRESENCE (static
+    pytree structure) is what marks the cache as rolling; the stored value
+    records the requested window for debugging only (the effective band is
+    the buffer length, min(window, max_len))."""
     buf_len = min(window, max_len) if window else max_len
     shape = (batch_size, buf_len, num_heads, head_dim)
     if quantize:
-        return {
+        cache = {
             "k": jnp.zeros(shape, dtype=jnp.int8),
             "k_scale": jnp.zeros(shape[:3] + (1,), dtype=jnp.float32),
             "v": jnp.zeros(shape, dtype=jnp.int8),
             "v_scale": jnp.zeros(shape[:3] + (1,), dtype=jnp.float32),
             "index": jnp.array(0, dtype=jnp.int32),
         }
-    return {
-        "k": jnp.zeros(shape, dtype=dtype),
-        "v": jnp.zeros(shape, dtype=dtype),
-        "index": jnp.array(0, dtype=jnp.int32),
-    }
+    else:
+        cache = {
+            "k": jnp.zeros(shape, dtype=dtype),
+            "v": jnp.zeros(shape, dtype=dtype),
+            "index": jnp.array(0, dtype=jnp.int32),
+        }
+    if window:
+        cache["rolling"] = jnp.array(window, dtype=jnp.int32)
+    return cache
