@@ -213,14 +213,17 @@ def _latest_banked_base() -> tuple[dict, str] | None:
                 row = json.loads(line)
             except ValueError:
                 continue
-            if (
-                row.get("metric") == _BANK_METRIC
-                and row.get("value")
-                and "tpu" in row.get("device", "").lower()
-            ):
+            try:
+                usable = (
+                    row.get("metric") == _BANK_METRIC
+                    and row.get("value")
+                    and "tpu" in row.get("device", "").lower()
+                )
                 ts = float(row.get("ts", 0.0))
-                if ts >= best_ts:
-                    best, best_path, best_ts = row, path, ts
+            except (AttributeError, TypeError, ValueError):
+                continue  # one malformed row must not break the contract
+            if usable and ts >= best_ts:
+                best, best_path, best_ts = row, path, ts
     if best is None:
         return None
     return best, best_path
@@ -325,19 +328,26 @@ def main() -> None:
     banked = _latest_banked_base() if infra_failure else None
     if banked is not None:
         row, path = banked
-        print(
-            json.dumps(
-                {
-                    "metric": _METRIC,
-                    "value": row["value"],
-                    "unit": row.get("unit", "tokens/sec/chip"),
-                    "vs_baseline": None,
-                    "stale": True,
-                    "stale_reason": tail or "benchmark subprocess produced no output",
-                    "stale_source": f"{os.path.basename(path)} (newest banked base row)",
-                }
-            )
-        )
+        out = {
+            "metric": _METRIC,
+            "value": row["value"],
+            "unit": row.get("unit", "tokens/sec/chip"),
+            "vs_baseline": None,
+            "stale": True,
+            "stale_reason": tail or "benchmark subprocess produced no output",
+            "stale_source": f"{os.path.basename(path)} (newest banked base row)",
+        }
+        # Surface how stale: the consumer decides whether a rounds-old row
+        # is still meaningful (no hard age cutoff — the VERDICT-requested
+        # behavior is "latest banked row, clearly labeled", and a labeled
+        # old number beats value:null for trend tracking).
+        if row.get("device"):
+            out["stale_device"] = row["device"]
+        if row.get("ts"):
+            out["stale_age_s"] = round(time.time() - float(row["ts"]), 1)
+        elif row.get("source"):
+            out["stale_provenance"] = row["source"]
+        print(json.dumps(out))
         return  # rc=0: the line carries a real (if stale) measurement
     print(
         json.dumps(

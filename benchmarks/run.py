@@ -90,7 +90,7 @@ def _configs():
 def bench_config(
     name: str, n_steps: int = 20, mode: str = "full", profile_dir: str = "",
     loss_chunks: int = 1, batch_override: int = 0, seq_override: int = 0,
-    flash_block: int = 0,
+    flash_block: int = 0, attn_impl: str = "",
 ) -> dict:
     """One measurement. ``mode`` attributes step time without trace tooling:
 
@@ -154,6 +154,12 @@ def bench_config(
         model_cfg = dataclasses.replace(
             model_cfg, flash_block_q=flash_block, flash_block_k=flash_block
         )
+    if attn_impl:
+        # Attention-impl A/B (the flash kernel has to EARN its 763 lines):
+        # long4k with attention_impl="xla" materializes the (B,H,S,S) fp32
+        # scores the way the reference does — if XLA's own lowering matches
+        # the Pallas kernel on-chip, flash should not be the default.
+        model_cfg = dataclasses.replace(model_cfg, attention_impl=attn_impl)
     if mode == "smallvocab":
         model_cfg = dataclasses.replace(model_cfg, target_vocab_size=2048)
     dev = jax.devices()[0]
@@ -248,6 +254,7 @@ def bench_config(
         + (f" [chunks={loss_chunks}]" if loss_chunks > 1 else "")
         + (f" [b{batch}xs{seq}]" if batch_override or seq_override else "")
         + (f" [fb{flash_block}]" if flash_block else "")
+        + (f" [{attn_impl}]" if attn_impl else "")
     )
     return {
         "metric": f"{name} train throughput" + tag,
@@ -385,6 +392,11 @@ def main() -> None:
         "--flash_block", type=int, default=0,
         help="override flash_block_q/k (flash-kernel tile sweep; 0 = keep)",
     )
+    ap.add_argument(
+        "--attn_impl", default="",
+        help="override ModelConfig.attention_impl (flash-vs-xla A/B at "
+        "long4k; empty = keep the config's impl)",
+    )
     args = ap.parse_args()
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
@@ -409,7 +421,8 @@ def main() -> None:
                      "--profile_dir", args.profile_dir,
                      "--loss_chunks", str(args.loss_chunks),
                      "--batch", str(args.batch), "--seq", str(args.seq),
-                     "--flash_block", str(args.flash_block)],
+                     "--flash_block", str(args.flash_block),
+                     "--attn_impl", args.attn_impl],
                     check=False,
                 )
         return
@@ -423,7 +436,7 @@ def main() -> None:
                     name, args.steps, mode, args.profile_dir,
                     loss_chunks=args.loss_chunks,
                     batch_override=args.batch, seq_override=args.seq,
-                    flash_block=args.flash_block,
+                    flash_block=args.flash_block, attn_impl=args.attn_impl,
                 )
             ),
             flush=True,
@@ -440,6 +453,8 @@ def main() -> None:
             (f" [{mode}]" if mode != "full" else "")
             + (f" [chunks={args.loss_chunks}]" if args.loss_chunks > 1 else "")
             + shapes
+            + (f" [fb{args.flash_block}]" if args.flash_block else "")
+            + (f" [{args.attn_impl}]" if args.attn_impl else "")
         )
         print(
             json.dumps(

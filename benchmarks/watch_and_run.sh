@@ -76,6 +76,8 @@ extra_metric() {
     ldecodeq8) echo "long4k decode throughput [decodeq8]" ;;
     fb256) echo "long4k train throughput [fb256]" ;;
     fb512) echo "long4k train throughput [fb512]" ;;
+    xla4k) echo "long4k train throughput [b1xs4096] [xla]" ;;
+    fl4k1) echo "long4k train throughput [b1xs4096]" ;;
     *) echo "base train throughput [$1]" ;;
   esac
 }
@@ -127,6 +129,10 @@ missing_extras() {
     || out="$out,fb256"
   grep -qF '"metric": "long4k train throughput [fb512]", "value"' "$EXTRA" 2>/dev/null \
     || out="$out,fb512"
+  grep -qF '"metric": "long4k train throughput [b1xs4096] [xla]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,xla4k"
+  grep -qF '"metric": "long4k train throughput [b1xs4096]", "value"' "$EXTRA" 2>/dev/null \
+    || out="$out,fl4k1"
   [ "$(value_count "base train throughput" "$EXTRA")" -ge 2 ] || out="$out,repbase"
   [ "$(value_count "tiny train throughput" "$EXTRA")" -ge 2 ] || out="$out,reptiny"
   echo "${out#,}"
@@ -282,6 +288,22 @@ while :; do
         timeout 2400 python benchmarks/run.py --configs long4k --flash_block "$B" >>"$EXTRA" 2>>"$ERR"
         rc=$?
         [ "$rc" -ne 0 ] && record_failure "long4k train throughput [$PICK]" "$EXTRA" "$rc"
+        ;;
+      xla4k)
+        # batch 1, not the config's 4: the xla path materializes (B,H,S,S)
+        # fp32 scores PLUS per-layer softmax residuals for backward — at
+        # batch 4 that alone exceeds 16 GB HBM. The flash side of the A/B
+        # (fl4k1) runs the same batch-1 shape so the comparison is exact.
+        log "running extra: long4k flash-vs-xla A/B [xla side, batch 1]"
+        timeout 2400 python benchmarks/run.py --configs long4k --batch 1 --attn_impl xla >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "long4k train throughput [b1xs4096] [xla]" "$EXTRA" "$rc"
+        ;;
+      fl4k1)
+        log "running extra: long4k flash-vs-xla A/B [flash side, batch 1]"
+        timeout 2400 python benchmarks/run.py --configs long4k --batch 1 >>"$EXTRA" 2>>"$ERR"
+        rc=$?
+        [ "$rc" -ne 0 ] && record_failure "long4k train throughput [b1xs4096]" "$EXTRA" "$rc"
         ;;
       repbase)
         log "running extra: base repeat row (variance/median)"
