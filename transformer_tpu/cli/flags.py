@@ -175,6 +175,12 @@ def define_flags() -> None:
         "pp_microbatches", 0,
         "GPipe microbatches per step (0 = one per stage); more microbatches "
         "shrink the pipeline bubble at the cost of smaller per-shard matmuls")
+    flags.DEFINE_enum(
+        "pp_schedule", "gpipe", ["gpipe", "1f1b"],
+        "pipeline schedule: 'gpipe' (autodiff backward, activation stash "
+        "grows with pp_microbatches) or '1f1b' (interleaved manual backward, "
+        "stash bounded at 2*stages-1 microbatches — raise pp_microbatches "
+        "freely; decoder-only dense models on data x pipe meshes)")
     flags.DEFINE_integer(
         "dcn_data", 1,
         "multi-slice: how many DCN-connected slices (processes off-TPU) the "
@@ -280,6 +286,7 @@ def flags_to_train_config() -> TrainConfig:
         enable_function=FLAGS.enable_function,
         seed=FLAGS.seed,
         pp_microbatches=FLAGS.pp_microbatches,
+        pp_schedule=FLAGS.pp_schedule,
         eval_max_batches=FLAGS.eval_max_batches,
         early_stop_patience=FLAGS.early_stop_patience,
         grad_accum_steps=FLAGS.grad_accum,

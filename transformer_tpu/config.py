@@ -239,6 +239,13 @@ class TrainConfig:
     # GPipe microbatches per step when the mesh has a pipe axis; 0 = one
     # microbatch per stage (parallel/pipeline.py).
     pp_microbatches: int = 0
+    # Pipeline schedule: "gpipe" (forward schedule + autodiff backward,
+    # activation stash grows with pp_microbatches) or "1f1b" (manual
+    # interleaved forward/backward schedule, stash bounded at 2*stages-1
+    # microbatches regardless of pp_microbatches — the pod-scale memory
+    # profile). 1f1b currently supports decoder-only dense models on
+    # data x pipe meshes (parallel/pipeline.py pipeline_train_1f1b).
+    pp_schedule: str = "gpipe"
     # Gradient accumulation: split each batch into this many sequential
     # micro-steps and sum gradients before one optimizer update — train
     # big-model global batches on small-HBM chips. 1 = off.
@@ -262,6 +269,10 @@ class TrainConfig:
         if self.loss_normalization not in ("tokens", "batch"):
             raise ValueError(
                 f"loss_normalization must be 'tokens' or 'batch', got {self.loss_normalization!r}"
+            )
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pp_schedule must be 'gpipe' or '1f1b', got {self.pp_schedule!r}"
             )
         if self.optimizer not in ("adam", "adafactor", "adamw"):
             raise ValueError(

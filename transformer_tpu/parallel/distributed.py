@@ -156,6 +156,160 @@ def _expert_parallel_forward(
     return forward
 
 
+def make_1f1b_train_step(
+    mesh: Mesh,
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    tx: Any = None,
+) -> Callable:
+    """Train step using the 1F1B pipeline schedule
+    (``parallel.pipeline.pipeline_train_1f1b``): same optimizer/metrics
+    contract as ``make_train_step``, but loss AND gradients come out of the
+    manual interleaved schedule — activation stash is O(stages), not
+    O(microbatches), which is what lets pp_microbatches grow to shrink the
+    bubble at pod scale without blowing HBM.
+
+    Supported surface (hard-checked): decoder-only dense models on
+    data x pipe meshes. The GPipe path keeps the wider composition matrix
+    (fsdp ZeRO-3 gather, model-axis GSPMD interiors, MoE aux, chunked loss);
+    those combinations raise here with a pointer back to pp_schedule=gpipe.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from transformer_tpu.config import PAD_ID
+    from transformer_tpu.models.decoder import decoder_layer_apply
+    from transformer_tpu.models.encoder import embed_prologue
+    from transformer_tpu.models.transformer import project_logits
+    from transformer_tpu.ops.masks import make_padding_mask
+    from transformer_tpu.ops.nn import layernorm_apply
+    from transformer_tpu.parallel.pipeline import (
+        pipeline_train_1f1b,
+        stack_layer_params,
+        unstack_layer_params,
+    )
+    from transformer_tpu.train.loss import masked_cross_entropy
+    from transformer_tpu.train.trainer import _shift_targets
+
+    if not model_cfg.decoder_only:
+        raise ValueError(
+            "pp_schedule='1f1b' currently supports decoder-only models; "
+            "seq2seq needs the chained encoder/decoder backward — use "
+            "pp_schedule='gpipe'"
+        )
+    if model_cfg.moe_experts:
+        raise ValueError(
+            "pp_schedule='1f1b' does not carry the MoE aux loss through its "
+            "manual backward; use pp_schedule='gpipe'"
+        )
+    if train_cfg.loss_chunks > 1:
+        raise ValueError(
+            "pp_schedule='1f1b' already bounds logits memory per microbatch; "
+            "loss_chunks>1 is unsupported with it (use pp_schedule='gpipe')"
+        )
+    if train_cfg.grad_accum_steps > 1:
+        raise ValueError(
+            "pp_schedule='1f1b' accumulates per microbatch already; raise "
+            "pp_microbatches instead of grad_accum_steps"
+        )
+    unsupported = {
+        a: mesh.shape[a]
+        for a in ("fsdp", "model", "seq", "expert")
+        if mesh.shape.get(a, 1) > 1
+    }
+    if unsupported:
+        raise ValueError(
+            f"pp_schedule='1f1b' composes with 'data' only, not {unsupported} "
+            "(fsdp/model interiors are wired through the GPipe path; use "
+            "pp_schedule='gpipe')"
+        )
+    if "pipe" not in mesh.shape:
+        raise ValueError(
+            "pp_schedule='1f1b' needs a 'pipe' mesh axis "
+            f"(mesh axes: {tuple(mesh.shape)})"
+        )
+
+    tx = tx or make_optimizer(model_cfg, train_cfg)
+    num_mb = train_cfg.pp_microbatches or mesh.shape["pipe"]
+
+    def layer_fn(lp, h, r, ti_mb, to_mb):
+        smask = make_padding_mask(ti_mb, PAD_ID)
+        out = decoder_layer_apply(
+            lp, h, None, smask, None, model_cfg, r, r is None
+        )
+        return out[0]
+
+    if model_cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def head_fn(nonlayer, h_mb, ti_mb, to_mb, inv_d):
+        if model_cfg.norm_scheme == "pre":
+            h_mb = layernorm_apply(
+                nonlayer["decoder"]["final_ln"], h_mb, model_cfg.layernorm_epsilon
+            )
+        logits = project_logits(nonlayer, h_mb, model_cfg)
+        _, m = masked_cross_entropy(
+            logits, to_mb,
+            label_smoothing=train_cfg.label_smoothing,
+            normalization="tokens",  # only the sums are consumed
+        )
+        # Objective pre-scaled by 1/denom: cotangent seed 1.0 then yields
+        # gradients in the final normalization directly.
+        return m["loss_sum"] * inv_d, {
+            "loss_sum": m["loss_sum"],
+            "weight": m["weight"],
+            "correct": m["correct"],
+        }
+
+    def train_step(state: TrainState, src, tgt, rng):
+        tar_inp, tar_out = _shift_targets(tgt)
+        step_rng = jax.random.fold_in(rng, state.step)
+        # Same 4-way split as pipelined_transformer_apply, so the
+        # decoder-only rng streams line up with the GPipe path.
+        _, r_embed_d, _, r_dec = jax.random.split(step_rng, 4)
+        weight = jnp.sum((tar_out != PAD_ID).astype(jnp.float32))
+        if train_cfg.loss_normalization == "tokens":
+            denom = jnp.maximum(weight, 1.0)
+        else:  # "batch": the reference's rule, train.py:88
+            denom = jnp.float32(train_cfg.batch_size)
+        params = state.params
+
+        def prologue(p):
+            return embed_prologue(
+                p["decoder"]["embedding"], tar_inp, model_cfg, r_embed_d, False
+            )
+
+        h0, pro_vjp = jax.vjp(prologue, params)
+        stacked = stack_layer_params(params["decoder"]["layers"])
+        nonlayer = {**params, "decoder": {**params["decoder"], "layers": ()}}
+        sums, d_h0, d_stacked, d_nonlayer = pipeline_train_1f1b(
+            stacked, nonlayer, h0, (tar_inp, tar_out),
+            layer_fn, head_fn, 1.0 / denom,
+            mesh=mesh, num_microbatches=num_mb, base_rng=r_dec,
+        )
+        (d_pro,) = pro_vjp(d_h0)
+        layer_grads = unstack_layer_params(d_stacked, model_cfg.num_layers)
+        d_engine = {
+            **d_nonlayer,
+            "decoder": {**d_nonlayer["decoder"], "layers": layer_grads},
+        }
+        grads = jax.tree.map(jnp.add, d_pro, d_engine)
+        metrics = {
+            "loss": sums["loss_sum"] / denom,
+            "loss_sum": sums["loss_sum"],
+            "weight": sums["weight"],
+            "correct": sums["correct"],
+        }
+        updates, new_opt_state = tx.update(grads, state.opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        return new_state, metrics
+
+    return train_step
+
+
 def _raw_sharded_steps(
     mesh: Mesh,
     model_cfg: ModelConfig,
@@ -209,11 +363,27 @@ def _raw_sharded_steps(
     hidden_forward_fn = (
         build_forward(hidden=True) if train_cfg.loss_chunks > 1 else None
     )
-    return (
-        make_train_step(
+    if train_cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown pp_schedule {train_cfg.pp_schedule!r}: "
+            "choose 'gpipe' or '1f1b'"
+        )
+    if (
+        mesh.shape.get("pipe", 1) > 1
+        and train_cfg.pp_schedule == "1f1b"
+    ):
+        # 1F1B swaps the TRAIN step only (loss+grads from the manual
+        # interleaved schedule); eval has no backward, so the GPipe forward
+        # built above stays — identical logits, no stash to bound. Without
+        # a pipe axis pp_schedule is inert (like pp_microbatches).
+        train = make_1f1b_train_step(mesh, model_cfg, train_cfg)
+    else:
+        train = make_train_step(
             model_cfg, train_cfg, forward_fn=forward_fn,
             hidden_forward_fn=hidden_forward_fn,
-        ),
+        )
+    return (
+        train,
         make_eval_step(
             model_cfg, train_cfg, forward_fn=forward_fn,
             hidden_forward_fn=hidden_forward_fn,

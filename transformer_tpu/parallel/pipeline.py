@@ -394,3 +394,264 @@ def pipelined_transformer_apply(
         return (y, enc_aux + dec_aux) if moe else y
     logits = _logits(params, y, cfg)
     return (logits, enc_aux + dec_aux) if moe else logits
+
+
+# --------------------------------------------------------------------------
+# 1F1B: interleaved forward/backward schedule with an O(stages) activation
+# stash (manual autodiff — jax.grad cannot interleave backward ticks with
+# forward ticks, so the engine owns its own vjp chaining).
+# --------------------------------------------------------------------------
+
+
+def gpipe_ticks(num_microbatches: int, num_stages: int) -> int:
+    """Wall ticks of the GPipe forward schedule: M + P - 1 (its backward is
+    the autodiff transpose, another M + P - 1). Bubble fraction per
+    direction: (P-1)/(M+P-1)."""
+    return num_microbatches + num_stages - 1
+
+def one_f1b_ticks(num_microbatches: int, num_stages: int) -> int:
+    """Wall ticks of the combined 1F1B schedule: M + 2(P-1). Each tick runs
+    ONE stage-forward and ONE stage-backward on every stage (SPMD cannot
+    skip work per-stage), so total compute ticks are M + 2P - 2 of (F+B)
+    versus GPipe's (M + P - 1) F plus (M + P - 1) B — a slightly LONGER
+    wall schedule. What 1F1B buys is memory, not ticks: microbatch i's
+    stage input is stashed at tick s+i and consumed by its backward at tick
+    2(P-1)+i-s, so at most ``one_f1b_stash_slots(P)`` microbatch
+    activations are ever live per stage, independent of M. GPipe's
+    autodiff backward stashes all M (well, M+P-1 scan residuals). At pod
+    scale the bubble is shrunk by raising M, which is exactly the regime
+    where GPipe's O(M) stash stops fitting and this schedule keeps working.
+    """
+    return num_microbatches + 2 * (num_stages - 1)
+
+def one_f1b_stash_slots(num_stages: int) -> int:
+    """Ring-buffer slots for stage-input stashes under 1F1B: 2P - 1.
+
+    Stage s's input for microbatch i is written at tick s+i and read back
+    at tick 2(P-1)+i-s; the longest lifetime (stage 0) spans 2(P-1) ticks,
+    during which 2P-1 distinct microbatches get written — so a ring of
+    2P-1 slots never overwrites a live entry (the same-tick write/read at
+    the last stage aliases deliberately: it reads the input it just
+    wrote)."""
+    return 2 * num_stages - 1
+
+
+def pipeline_train_1f1b(
+    stacked_params: Params,
+    nonlayer_params: Params,
+    h0: jax.Array,
+    mb_streams: tuple[jax.Array, ...],
+    layer_fn: Callable,
+    head_fn: Callable,
+    inv_denom: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    base_rng: jax.Array | None = None,
+    axis: str = "pipe",
+    batch_axes: tuple[str, ...] = ("data",),
+) -> tuple[dict, jax.Array, Params, Params]:
+    """One fused forward+backward pass of a homogeneous layer stack under the
+    non-interleaved 1F1B schedule, returning loss sums and gradients.
+
+    The engine is its own autodiff: ``jax.grad`` over the GPipe scan must
+    finish ALL forwards before its transposed backward starts (that is what
+    reverse-mode means), which forces the O(M)-microbatch activation stash.
+    Here each scan tick runs one stage-forward AND one stage-backward
+    (``jax.vjp`` of the stage, rematerialized from a stashed stage input),
+    cotangents hop backward over the same ``ppermute`` ring the activations
+    hop forward on, and the stash is a ``one_f1b_stash_slots(P)``-deep ring —
+    activation memory is O(P), independent of M. See ``one_f1b_ticks`` for
+    the tick/bubble accounting.
+
+    Args:
+      stacked_params: layer params stacked on a leading axis (sharded over
+        ``axis`` by the shard_map in_spec, exactly as ``pipeline_apply``).
+      nonlayer_params: the FULL parameter tree with the pipelined stack's
+        layer list replaced by an empty container — embedding/final-LN/output
+        leaves replicated into every stage (the loss head needs them; grads
+        for them are psum'd over ``axis`` + ``batch_axes``).
+      h0: (B_local, S, D) post-prologue activations (prologue runs OUTSIDE,
+        under plain GSPMD, so its params may keep any sharding; its backward
+        chains through the returned ``d_h0``).
+      mb_streams: per-example side inputs, each (B_local, ...) — microbatched
+        like ``h0`` and handed to ``layer_fn``/``head_fn`` per microbatch
+        (token ids for mask building, shifted targets for the loss).
+      layer_fn: ``layer_fn(lp, h, rng|None, *streams_mb) -> h`` for ONE layer.
+      head_fn: ``head_fn(nonlayer_params, h_out_mb, *streams_mb, inv_denom)
+        -> (objective_scalar, sums_dict)`` — the loss head applied to the
+        last stage's output microbatch. ``objective`` must already be scaled
+        so cotangent seed 1.0 yields final-normalization gradients
+        (i.e. objective = loss_sum * inv_denom); ``sums_dict`` carries fp32
+        scalars {"loss_sum", "weight", "correct"}.
+      inv_denom: fp32 scalar, 1/denominator of the loss normalization
+        (computed OUTSIDE over the full batch: per-microbatch normalizers
+        would weight microbatches wrongly under "tokens" normalization).
+
+    Returns ``(sums, d_h0, d_stacked, d_nonlayer)``:
+      sums: global fp32 scalars {"loss_sum", "weight", "correct"}.
+      d_h0: cotangent of ``h0`` (batch-sharded like ``h0``) — feed it to the
+        prologue's ``jax.vjp`` to finish the chain.
+      d_stacked: gradient tree like ``stacked_params`` (stage-sharded).
+      d_nonlayer: gradient tree like ``nonlayer_params`` (replicated).
+
+    Numerics match the GPipe + autodiff path up to summation order: the same
+    per-(layer, microbatch) rng folding, the same stage math, gradients
+    accumulated per microbatch instead of transposed en bloc.
+    """
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    n_stages = mesh.shape[axis]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"pipe axis size {n_stages} must divide num_layers {num_layers}"
+        )
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+
+    params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    nonlayer_spec = jax.tree.map(lambda _: P(), nonlayer_params)
+    bspec = P(batch_axes)
+    streams_spec = tuple(P(batch_axes) for _ in mb_streams)
+
+    M = num_microbatches
+    T = one_f1b_ticks(M, n_stages)
+    S_buf = one_f1b_stash_slots(n_stages)
+    layers_per_stage = num_layers // n_stages
+    sums_spec = {"loss_sum": P(), "weight": P(), "correct": P()}
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(params_spec, nonlayer_spec, bspec, streams_spec, P(), P()),
+        out_specs=(
+            sums_spec,
+            bspec,
+            params_spec,
+            nonlayer_spec,
+        ),
+        check_vma=False,
+        axis_names=set(mesh.axis_names),
+    )
+    def _engine(local_params, nonlayer, h0_local, streams_local, rng, inv_d):
+        batch = h0_local.shape[0]
+        if batch % M:
+            raise ValueError(
+                f"num_microbatches {M} must divide the per-shard batch {batch}"
+            )
+        mb = batch // M
+        h_mbs = h0_local.reshape(M, mb, *h0_local.shape[1:])
+        streams_mbs = tuple(
+            s.reshape(M, mb, *s.shape[1:]) for s in streams_local
+        )
+        stage = jax.lax.axis_index(axis)
+        is_last = stage == n_stages - 1
+        is_first = stage == 0
+
+        def stage_fwd(lp, h, mb_idx, streams_mb):
+            def one_layer(h, xs):
+                local_i, layer_p = xs
+                if base_rng is None:
+                    r = None
+                else:
+                    global_layer = stage * layers_per_stage + local_i
+                    r = jax.random.fold_in(
+                        jax.random.fold_in(rng, global_layer), mb_idx
+                    )
+                return layer_fn(layer_p, h, r, *streams_mb), None
+
+            h, _ = jax.lax.scan(
+                one_layer, h, (jnp.arange(layers_per_stage), lp)
+            )
+            return h
+
+        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+        bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
+
+        def masked_add(acc, g, valid):
+            return jax.tree.map(
+                lambda a, x: a + jnp.where(valid, x, 0).astype(a.dtype), acc, g
+            )
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, stash, d_stk, d_non, sums = carry
+
+            # ---- forward half: stage s runs F of microbatch t - s ----
+            f_mb = t - stage
+            f_c = jnp.clip(f_mb, 0, M - 1)
+            streams_f = tuple(s[f_c] for s in streams_mbs)
+            inp = jnp.where(is_first, h_mbs[f_c], fwd_buf)
+            # Ring-stash the stage INPUT (backward rematerializes from it).
+            # Unconditional write: slot f_c % S_buf is free by construction
+            # (one_f1b_stash_slots) and garbage ticks write garbage that is
+            # overwritten before any valid backward reads it.
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, inp, f_c % S_buf, 0
+            )
+            out = stage_fwd(local_params, inp, f_c, streams_f)
+            fwd_nxt = (
+                jax.lax.ppermute(out, axis, fwd_perm) if n_stages > 1 else out
+            )
+
+            # ---- backward half: stage s runs B of microbatch
+            #      t - 2(P-1) + s, rematerializing its forward ----
+            b_mb = t - 2 * (n_stages - 1) + stage
+            b_valid = jnp.logical_and(b_mb >= 0, b_mb < M)
+            b_c = jnp.clip(b_mb, 0, M - 1)
+            streams_b = tuple(s[b_c] for s in streams_mbs)
+            x_in = stash[b_c % S_buf]
+            h_out_rec, stage_vjp = jax.vjp(
+                lambda lp, h: stage_fwd(lp, h, b_c, streams_b),
+                local_params, x_in,
+            )
+            # Loss head on the (recomputed) last-stage output: its vjp both
+            # seeds the backward chain and yields the head-param grads.
+            _, head_vjp, head_sums = jax.vjp(
+                lambda nl, h: head_fn(nl, h, *streams_b, inv_d),
+                nonlayer, h_out_rec, has_aux=True,
+            )
+            d_non_mb, d_head_h = head_vjp(jnp.float32(1.0))
+            d_out = jnp.where(is_last, d_head_h.astype(bwd_buf.dtype), bwd_buf)
+            d_lp, d_in = stage_vjp(d_out)
+            d_stk = masked_add(d_stk, d_lp, b_valid)
+            d_non = masked_add(d_non, d_non_mb, jnp.logical_and(b_valid, is_last))
+            sums = masked_add(sums, head_sums, jnp.logical_and(b_valid, is_last))
+            bwd_nxt = (
+                jax.lax.ppermute(d_in, axis, bwd_perm) if n_stages > 1 else d_in
+            )
+            return (fwd_nxt, bwd_nxt, stash, d_stk, d_non, sums), d_in
+
+        zero_act = jnp.zeros_like(h_mbs[0])
+        init = (
+            zero_act,
+            zero_act,
+            jnp.zeros((S_buf, *zero_act.shape), zero_act.dtype),
+            jax.tree.map(jnp.zeros_like, local_params),
+            jax.tree.map(jnp.zeros_like, nonlayer),
+            {k: jnp.float32(0.0) for k in ("loss_sum", "weight", "correct")},
+        )
+        (_, _, _, d_stk, d_non, sums), d_in_ticks = jax.lax.scan(
+            tick, init, jnp.arange(T)
+        )
+
+        # Stage 0's backward for microbatch i lands at tick 2(P-1)+i: the
+        # tail slice of the per-tick d_in outputs, masked to stage 0 and
+        # broadcast over pipe, is d(h0) in microbatch order.
+        d_h0_mbs = d_in_ticks[2 * (n_stages - 1) :]
+        d_h0_mbs = jax.lax.psum(
+            d_h0_mbs * is_first.astype(d_h0_mbs.dtype), axis
+        )
+        d_h0 = d_h0_mbs.reshape(batch, *h0_local.shape[1:])
+
+        reduce_axes = (axis,) + batch_axes
+        sums = {k: jax.lax.psum(v, reduce_axes) for k, v in sums.items()}
+        d_non = jax.tree.map(lambda g: jax.lax.psum(g, reduce_axes), d_non)
+        if batch_axes:
+            d_stk = jax.tree.map(
+                lambda g: jax.lax.psum(g, batch_axes), d_stk
+            )
+        return sums, d_h0, d_stk, d_non
+
+    rng_in = base_rng if base_rng is not None else jax.random.PRNGKey(0)
+    return _engine(
+        stacked_params, nonlayer_params, h0, mb_streams, rng_in,
+        jnp.asarray(inv_denom, jnp.float32),
+    )
