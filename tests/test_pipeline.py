@@ -607,9 +607,6 @@ class Test1F1B:
         )
         with pytest.raises(ValueError, match="composes with 'data'"):
             make_1f1b_train_step(fsdp_mesh, self.MODEL, tc)
+        # Unknown schedule names are rejected at TrainConfig construction.
         with pytest.raises(ValueError, match="pp_schedule"):
-            from transformer_tpu.parallel import make_sharded_steps
-
-            make_sharded_steps(
-                mesh, self.MODEL, self._tcfg(pp_schedule="zigzag"), None
-            )
+            self._tcfg(pp_schedule="zigzag")

@@ -62,7 +62,11 @@ def define_flags() -> None:
         "explicitly-passed flags override preset values")
     # --- reference-surface flags (utils.py:18-33 defaults) ---
     flags.DEFINE_string("dataset_path", "data", "directory with src/tgt line files")
-    flags.DEFINE_integer("buffer_size", 100000, "shuffle buffer (compat; full-shuffle used)")
+    flags.DEFINE_integer(
+        "buffer_size", 100000,
+        "shuffle buffer size: with --streaming this bounds host memory (the "
+        "reference's utils.py:154 semantics); the in-memory path ignores it "
+        "(full permutation is free there)")
     flags.DEFINE_string("src_vocab_file", "src_vocab.subwords", "source subword vocab path")
     flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords", "target subword vocab path")
     flags.DEFINE_integer("sequence_length", 50, "max sequence length (tokens incl. BOS/EOS)")
@@ -153,6 +157,11 @@ def define_flags() -> None:
         "comma-separated ascending batch widths (e.g. '24,36,50', last <= "
         "sequence_length): batches pad to the smallest fitting bucket — "
         "one compile per bucket, far fewer padding FLOPs ('' = off)")
+    flags.DEFINE_boolean(
+        "streaming", False,
+        "stream the train corpus from disk with a --buffer_size shuffle "
+        "buffer instead of loading it into RAM (corpora larger than host "
+        "memory; needs pre-built vocab files; seq2seq pipeline only)")
     flags.DEFINE_string("profile_dir", "", "capture a jax.profiler trace into this dir")
     flags.DEFINE_integer("profile_start_step", 2, "first step of the profile window")
     flags.DEFINE_integer("profile_num_steps", 3, "profile window length in steps")

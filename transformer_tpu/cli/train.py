@@ -79,13 +79,26 @@ def main(argv) -> None:
             sequence_length=train_cfg.sequence_length,
             target_vocab_size=FLAGS.target_vocab_size,
             seed=train_cfg.seed,
-            prefetch=FLAGS.native_loader,  # composes with length_buckets (native bucketed plan)
+            # streaming reads the corpus line-by-line (O(buffer_size) host
+            # memory) and excludes the native loader / bucket planner, which
+            # need the in-memory example table.
+            prefetch=FLAGS.native_loader and not FLAGS.streaming,
             length_buckets=buckets,
+            streaming=FLAGS.streaming,
+            buffer_size=FLAGS.buffer_size,
         )
-    logging.info(
-        "data: %d train examples, vocabs %d/%d",
-        train_ds.num_examples, src_tok.vocab_size, tgt_tok.vocab_size,
-    )
+    if FLAGS.streaming:
+        # num_examples would force a full line-count scan of the corpus
+        # before training — the exact startup cost streaming exists to avoid.
+        logging.info(
+            "data: streaming corpus (buffer %d), vocabs %d/%d",
+            FLAGS.buffer_size, src_tok.vocab_size, tgt_tok.vocab_size,
+        )
+    else:
+        logging.info(
+            "data: %d train examples, vocabs %d/%d",
+            train_ds.num_examples, src_tok.vocab_size, tgt_tok.vocab_size,
+        )
     model_cfg = flags_to_model_config(
         src_tok.model_vocab_size, tgt_tok.model_vocab_size
     )

@@ -33,11 +33,10 @@ from transformer_tpu.config import PAD_ID
 from transformer_tpu.data.tokenizer import SubwordTokenizer
 
 
-def read_parallel_corpus(
-    dataset_path: str, split: str = "train"
-) -> tuple[list[str], list[str]]:
-    """Read zipped src/tgt line files matching ``{src,tgt}-{split}*.txt``
-    (the reference's glob convention, ``utils.py:65-80,130-133``)."""
+def corpus_files(dataset_path: str, split: str) -> tuple[list[str], list[str]]:
+    """Glob the src/tgt line files for one split — the reference's file
+    convention (``utils.py:65-80,130-133``), shared by the in-memory and
+    streaming readers so both accept exactly the same corpora."""
     src_files = sorted(glob.glob(os.path.join(dataset_path, f"src-{split}*.txt")))
     tgt_files = sorted(glob.glob(os.path.join(dataset_path, f"tgt-{split}*.txt")))
     if not src_files or not tgt_files:
@@ -45,6 +44,15 @@ def read_parallel_corpus(
             f"no {split} corpus under {dataset_path!r} "
             f"(expected src-{split}*.txt / tgt-{split}*.txt)"
         )
+    return src_files, tgt_files
+
+
+def read_parallel_corpus(
+    dataset_path: str, split: str = "train"
+) -> tuple[list[str], list[str]]:
+    """Read zipped src/tgt line files matching ``{src,tgt}-{split}*.txt``
+    (the reference's glob convention, ``utils.py:65-80,130-133``)."""
+    src_files, tgt_files = corpus_files(dataset_path, split)
     src_lines: list[str] = []
     tgt_lines: list[str] = []
     for sf, tf in zip(src_files, tgt_files):
@@ -417,9 +425,20 @@ def load_dataset(
     prefetch: bool = False,
     length_buckets: tuple[int, ...] = (),
     exclude_test_overlap: bool = False,
+    streaming: bool = False,
+    buffer_size: int = 10000,
 ) -> tuple[Seq2SeqDataset, Seq2SeqDataset | None, SubwordTokenizer, SubwordTokenizer]:
     """Build train (+ optional test) datasets plus both tokenizers —
     the counterpart of reference ``load_dataset`` (``utils.py:114-161``).
+
+    ``streaming=True`` swaps the train split for a
+    ``data.streaming.StreamingSeq2SeqDataset``: the corpus is read and
+    tokenized line-by-line with a ``buffer_size``-example shuffle buffer
+    (the reference's ``--buffer_size`` semantics, ``utils.py:154``), so host
+    memory stays O(buffer_size) no matter how large the corpus files are.
+    Vocab files must already exist in streaming mode (building a vocabulary
+    needs its own corpus pass — run once without streaming, or train vocabs
+    on a sample). The (small) test split stays in-memory.
 
     Train examples with either side longer than ``sequence_length`` (after
     BOS/EOS framing) are dropped, mirroring the reference filter
@@ -434,6 +453,44 @@ def load_dataset(
     are still built from the FULL train files, so persisted ``*.subwords``
     caches are identical with and without the holdout.
     """
+    if streaming:
+        if prefetch or length_buckets:
+            raise ValueError(
+                "streaming=True does not compose with prefetch or "
+                "length_buckets (the native loader and bucket planner need "
+                "the in-memory example table)"
+            )
+        if not (os.path.exists(src_vocab_file) and os.path.exists(tgt_vocab_file)):
+            raise FileNotFoundError(
+                "streaming=True needs pre-built vocab files "
+                f"({src_vocab_file!r}, {tgt_vocab_file!r}): vocabulary "
+                "construction requires its own corpus pass — run once "
+                "without streaming (or build vocabs from a sample) first"
+            )
+        from transformer_tpu.data.streaming import StreamingSeq2SeqDataset
+
+        src_tok = SubwordTokenizer.load(src_vocab_file)
+        tgt_tok = SubwordTokenizer.load(tgt_vocab_file)
+        held: set[tuple[str, str]] = set()
+        if exclude_test_overlap:
+            try:
+                held_src, held_tgt = read_parallel_corpus(dataset_path, "test")
+                held = set(zip(held_src, held_tgt))
+            except FileNotFoundError:
+                pass
+        stream_train = StreamingSeq2SeqDataset(
+            dataset_path, src_tok, tgt_tok,
+            batch_size=batch_size, sequence_length=sequence_length,
+            buffer_size=buffer_size, seed=seed,
+            shard_index=shard_index, shard_count=shard_count,
+            exclude_pairs=held,
+        )
+        test = _build_test_split(
+            dataset_path, src_tok, tgt_tok, batch_size, sequence_length,
+            shard_index, shard_count, require_test,
+        )
+        return stream_train, test, src_tok, tgt_tok
+
     src_lines, tgt_lines = read_parallel_corpus(dataset_path, "train")
     src_tok = load_or_build_tokenizer(src_vocab_file, src_lines, target_vocab_size)
     tgt_tok = load_or_build_tokenizer(tgt_vocab_file, tgt_lines, target_vocab_size)
@@ -474,39 +531,56 @@ def load_dataset(
         length_buckets=length_buckets,
     )
 
-    test: Seq2SeqDataset | None = None
+    test = _build_test_split(
+        dataset_path, src_tok, tgt_tok, batch_size, sequence_length,
+        shard_index, shard_count, require_test,
+    )
+    return train, test, src_tok, tgt_tok
+
+
+def _build_test_split(
+    dataset_path: str,
+    src_tok: SubwordTokenizer,
+    tgt_tok: SubwordTokenizer,
+    batch_size: int,
+    sequence_length: int,
+    shard_index: int,
+    shard_count: int,
+    require_test: bool,
+) -> Seq2SeqDataset | None:
+    """The (small, always in-memory) test split shared by the in-memory and
+    streaming train paths."""
     try:
         test_src_lines, test_tgt_lines = read_parallel_corpus(dataset_path, "test")
     except FileNotFoundError:
         if require_test:
             raise
-        test_src_lines = None
-    if test_src_lines is not None:
-        def _truncate_keep_eos(arrs: list[np.ndarray], eos: int) -> list[np.ndarray]:
-            # Over-length eval examples are cut to fit the positional table,
-            # but keep the EOS frame token the model always trained with.
-            return [
-                a if len(a) <= sequence_length
-                else np.concatenate([a[: sequence_length - 1], [eos]]).astype(np.int32)
-                for a in arrs
-            ]
+        return None
 
-        tsrc = _truncate_keep_eos(_encode_and_frame(test_src_lines, src_tok), src_tok.eos_id)
-        ttgt = _truncate_keep_eos(_encode_and_frame(test_tgt_lines, tgt_tok), tgt_tok.eos_id)
-        # No length *filter* on test (reference ``utils.py:157-159``) — pad to
-        # one rounded-up max so eval compiles once, but cap at
-        # ``sequence_length``: the positional table is sized to it, so longer
-        # examples are truncated rather than crashing eval (the reference only
-        # survived these because its table was vocab-sized, quirk §2.3.5).
-        test = Seq2SeqDataset(
-            tsrc,
-            ttgt,
-            batch_size=batch_size,
-            src_len=min(_round_up(max(len(a) for a in tsrc)), sequence_length),
-            tgt_len=min(_round_up(max(len(a) for a in ttgt)), sequence_length),
-            shuffle=False,
-            drop_remainder=False,
-            shard_index=shard_index,
-            shard_count=shard_count,
-        )
-    return train, test, src_tok, tgt_tok
+    def _truncate_keep_eos(arrs: list[np.ndarray], eos: int) -> list[np.ndarray]:
+        # Over-length eval examples are cut to fit the positional table,
+        # but keep the EOS frame token the model always trained with.
+        return [
+            a if len(a) <= sequence_length
+            else np.concatenate([a[: sequence_length - 1], [eos]]).astype(np.int32)
+            for a in arrs
+        ]
+
+    tsrc = _truncate_keep_eos(_encode_and_frame(test_src_lines, src_tok), src_tok.eos_id)
+    ttgt = _truncate_keep_eos(_encode_and_frame(test_tgt_lines, tgt_tok), tgt_tok.eos_id)
+    # No length *filter* on test (reference ``utils.py:157-159``) — pad to
+    # one rounded-up max so eval compiles once, but cap at
+    # ``sequence_length``: the positional table is sized to it, so longer
+    # examples are truncated rather than crashing eval (the reference only
+    # survived these because its table was vocab-sized, quirk §2.3.5).
+    return Seq2SeqDataset(
+        tsrc,
+        ttgt,
+        batch_size=batch_size,
+        src_len=min(_round_up(max(len(a) for a in tsrc)), sequence_length),
+        tgt_len=min(_round_up(max(len(a) for a in ttgt)), sequence_length),
+        shuffle=False,
+        drop_remainder=False,
+        shard_index=shard_index,
+        shard_count=shard_count,
+    )

@@ -363,11 +363,7 @@ def _raw_sharded_steps(
     hidden_forward_fn = (
         build_forward(hidden=True) if train_cfg.loss_chunks > 1 else None
     )
-    if train_cfg.pp_schedule not in ("gpipe", "1f1b"):
-        raise ValueError(
-            f"unknown pp_schedule {train_cfg.pp_schedule!r}: "
-            "choose 'gpipe' or '1f1b'"
-        )
+    # (pp_schedule values are validated at TrainConfig construction.)
     if (
         mesh.shape.get("pipe", 1) > 1
         and train_cfg.pp_schedule == "1f1b"
