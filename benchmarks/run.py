@@ -351,6 +351,12 @@ def _bench_decode(
             "kv_cache_int8": model_cfg.kv_cache_int8,
         },
         "ms_per_token": round(dt / (max_len * n_iters) * 1e3, 3),
+        # Serving view of the same measurement (cli.serve --serve_batch
+        # aggregates concurrent requests into exactly this shape): each
+        # decode completes `batch` requests together, so p50 request
+        # latency = one decode's wall time.
+        "requests_per_sec": round(batch * n_iters / dt, 2),
+        "p50_request_ms": round(dt / n_iters * 1e3, 1),
         "device": f"{dev.platform}:{dev.device_kind}",
         "vs_baseline": None,  # reference decode is broken (SURVEY §2.3.2/.11)
     }

@@ -142,3 +142,77 @@ export_params(transformer_init(jax.random.PRNGKey(0), cfg), cfg, r"{tmp_path}/mo
     assert "translation" in lines[0]
     assert "translation" in lines[1]
     assert "error" in lines[2]
+
+
+def test_serve_lines_batches_one_decode_per_group(monkeypatch):
+    """>=2 concurrent requests with the same decode signature must go
+    through ONE translate() call (the batched-serving contract); different
+    signatures split into their own groups; order is preserved and a
+    malformed line is answered without a decode."""
+    from transformer_tpu.cli import serve as serve_mod
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.train import decode as decode_mod
+
+    calls = []
+
+    def fake_translate(params, cfg, src_tok, tgt_tok, sentences, **kw):
+        calls.append((tuple(sentences), kw["beam_size"]))
+        return [f"T({s})" for s in sentences]
+
+    monkeypatch.setattr(decode_mod, "translate", fake_translate)
+    cfg = ModelConfig(
+        num_layers=1, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=32, target_vocab_size=32, max_position=16,
+        decoder_only=False,
+    )
+    lines = [
+        "hello there",                      # greedy group
+        '{"src": "b", "beam": 2}',          # beam-2 group
+        "not json but raw",                 # greedy group (same signature)
+        "{broken json",                     # malformed: answered, no decode
+        '{"src": "c", "beam": 2}',          # beam-2 group
+    ]
+    resp = serve_mod.serve_lines(lines, None, cfg, None, None)
+    assert len(calls) == 2  # one decode per signature group
+    grouped = {beam: s for s, beam in calls}
+    assert grouped[1] == ("hello there", "not json but raw")
+    assert grouped[2] == ("b", "c")
+    assert resp[0] == {"translation": "T(hello there)"}
+    assert resp[1] == {"translation": "T(b)"}
+    assert resp[2] == {"translation": "T(not json but raw)"}
+    assert "error" in resp[3]
+    assert resp[4] == {"translation": "T(c)"}
+
+
+def test_serve_lines_error_isolation(monkeypatch):
+    """A request with an unconvertible field answers with an error (not a
+    crash), and a group-poisoning request must not fail its innocent
+    co-batched neighbors: the group retries per member."""
+    from transformer_tpu.cli import serve as serve_mod
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.train import decode as decode_mod
+
+    def fake_translate(params, cfg, src_tok, tgt_tok, sentences, **kw):
+        if "poison" in sentences:
+            raise RuntimeError("decode blew up")
+        return [f"T({s})" for s in sentences]
+
+    monkeypatch.setattr(decode_mod, "translate", fake_translate)
+    cfg = ModelConfig(
+        num_layers=1, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=32, target_vocab_size=32, max_position=16,
+        decoder_only=False,
+    )
+    resp = serve_mod.serve_lines(
+        [
+            '{"src": "a", "beam": "four"}',  # unconvertible field
+            "good one",
+            "poison",                        # fails the batched decode
+            "good two",
+        ],
+        None, cfg, None, None,
+    )
+    assert "error" in resp[0] and "ValueError" in resp[0]["error"]
+    assert resp[1] == {"translation": "T(good one)"}
+    assert "error" in resp[2] and "decode blew up" in resp[2]["error"]
+    assert resp[3] == {"translation": "T(good two)"}
