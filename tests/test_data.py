@@ -475,3 +475,104 @@ class TestStreaming:
         src, tgt = next(train.batches(0))
         assert src.shape == (4, 24) and tgt.shape == (4, 24)
         assert (src[:, 0] == src_tok.bos_id).all()
+
+
+class TestTfdsCompat:
+    """tfds-format .subwords importer (data/tfds_compat.py): the tokenizer
+    comparability bridge to vocabularies saved by real reference runs."""
+
+    # A hand-built tfds-style vocabulary: multi-char merges first, then the
+    # single-char alphabet incl. the escape machinery chars (tfds's build
+    # always emits those), exactly as SubwordTextEncoder.save_to_file lays
+    # a file out.
+    PIECES = [
+        "the_", "quick_", "bro", "wn_", "fox", "es_",
+        "a", "b", "c", "d", "e", "f", "h", "i", "k", "n", "o", "q",
+        "r", "s", "t", "u", "w", "x", "_", "\\", ";", ".",
+    ] + list("0123456789")
+
+    @pytest.fixture()
+    def vocab_file(self, tmp_path):
+        p = tmp_path / "ref.subwords"
+        lines = ["### SubwordTextEncoder", "### Metadata: {}"]
+        lines += [
+            "'" + s.replace("\\", "\\\\").replace("\n", "\\n") + "'"
+            for s in self.PIECES
+        ]
+        p.write_text("\n".join(lines) + "\n")
+        return str(p)
+
+    def test_load_and_id_space(self, vocab_file):
+        from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+        tok = TfdsSubwordTokenizer.load(vocab_file)
+        n = len(self.PIECES)
+        assert tok.subwords == self.PIECES  # file order == id order (1-based)
+        assert tok.vocab_size == 1 + n + 256  # pad + subwords + byte fallback
+        assert tok.bos_id == tok.vocab_size
+        assert tok.eos_id == tok.vocab_size + 1
+        assert tok.model_vocab_size == tok.vocab_size + 2
+        # id 1 is the first file line, the tfds layout BLEU comparability
+        # depends on.
+        assert tok.encode("the")[:1] == [1]
+
+    def test_roundtrip(self, vocab_file):
+        from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+        tok = TfdsSubwordTokenizer.load(vocab_file)
+        for text in (
+            "the quick brown fox",
+            "the quick the quick",
+            "foxes run under_scores and back\\slashes",  # escape chars
+            "punct. at ends.",
+            "unicode: über café",  # chars outside the alphabet
+            "digits 0123 and ; semicolons",
+        ):
+            ids = tok.encode(text)
+            assert all(0 < i < tok.vocab_size for i in ids)
+            assert tok.decode(ids) == text, text
+
+    def test_greedy_longest_match(self, vocab_file):
+        from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+        tok = TfdsSubwordTokenizer.load(vocab_file)
+        # "the" must take the merged piece "the_", not t-h-e singles.
+        assert tok.encode("the") == [1]
+        # "foxes" = "fox" + "es_" (greedy prefix), not single chars.
+        assert tok.encode("foxes") == [
+            self.PIECES.index("fox") + 1, self.PIECES.index("es_") + 1
+        ]
+
+    def test_transparent_via_subword_load(self, vocab_file):
+        """SubwordTokenizer.load must sniff the tfds header and return the
+        compat tokenizer, so every CLI --*_vocab_file accepts reference
+        vocabularies unchanged."""
+        from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+        tok = SubwordTokenizer.load(vocab_file)
+        assert isinstance(tok, TfdsSubwordTokenizer)
+        assert tok.decode(tok.encode("the quick")) == "the quick"
+
+    def test_save_roundtrips_file(self, vocab_file, tmp_path):
+        from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+        tok = TfdsSubwordTokenizer.load(vocab_file)
+        out = str(tmp_path / "resaved.subwords")
+        tok.save(out)
+        tok2 = TfdsSubwordTokenizer.load(out)
+        assert tok2.subwords == tok.subwords
+
+    def test_byte_fallback_ids(self, vocab_file):
+        from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+        tok = TfdsSubwordTokenizer.load(vocab_file)
+        n = len(self.PIECES)
+        # A char in no subword and outside the alphabet escapes to \<ord>;
+        # whose digits/backslash/semicolon ARE in the vocab — ids stay in
+        # the subword range. But a vocab missing those would byte-fall-back;
+        # simulate by encoding a char whose escape digits exist: verify the
+        # escape produces a decodable id sequence either way.
+        ids = tok.encode("café")
+        assert tok.decode(ids) == "café"
+        assert all(0 < i < tok.vocab_size for i in ids)
+        assert n  # silence unused warning

@@ -72,10 +72,17 @@ def load_or_build_tokenizer(
     vocab_file: str,
     corpus: list[str] | None = None,
     target_vocab_size: int = 2**15,
-) -> SubwordTokenizer:
+):  # -> SubwordTokenizer | tfds_compat.TfdsSubwordTokenizer (duck-typed)
     """Load a persisted vocab, else train from the corpus and persist —
-    the reference's first-run-builds behavior (``utils.py:96-111``)."""
+    the reference's first-run-builds behavior (``utils.py:96-111``).
+
+    A vocab file in tfds ``SubwordTextEncoder`` format (saved by a real run
+    of the reference under TF) is detected by its header and loaded through
+    ``data.tfds_compat`` — same id space, so BLEU comparisons against that
+    run share a vocabulary."""
     if os.path.exists(vocab_file):
+        # SubwordTokenizer.load sniffs the format and routes tfds-format
+        # files through data.tfds_compat automatically.
         return SubwordTokenizer.load(vocab_file)
     if corpus is None:
         raise FileNotFoundError(f"vocab file {vocab_file!r} missing and no corpus given")

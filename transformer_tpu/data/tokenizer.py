@@ -300,11 +300,26 @@ class SubwordTokenizer:
         os.replace(tmp, path)
 
     @classmethod
-    def load(cls, path: str) -> "SubwordTokenizer":
+    def load(cls, path: str):
+        """Load a vocab file. A file in tfds ``SubwordTextEncoder`` format
+        (the reference's ``save_to_file`` output, ``utils.py:100,104``) is
+        detected by its header and returned as a duck-typed
+        ``data.tfds_compat.TfdsSubwordTokenizer`` — every CLI/pipeline
+        entry point thereby accepts vocabularies saved by a real run of the
+        reference, which is what makes BLEU comparisons share an id space."""
         with open(path, encoding="utf-8") as f:
             header = f.readline().rstrip("\n")
-            if header != "transformer_tpu_subwords_v1":
-                raise ValueError(f"{path}: not a transformer_tpu subword vocab file")
+        if header.startswith("### SubwordTextEncoder"):
+            from transformer_tpu.data.tfds_compat import TfdsSubwordTokenizer
+
+            return TfdsSubwordTokenizer.load(path)
+        if header != "transformer_tpu_subwords_v1":
+            raise ValueError(
+                f"{path}: neither a transformer_tpu nor a tfds subword "
+                "vocab file"
+            )
+        with open(path, encoding="utf-8") as f:
+            f.readline()  # header
             subwords = [
                 line.rstrip("\n").encode("ascii").decode("unicode_escape")
                 for line in f
