@@ -123,6 +123,7 @@ class TestPartitionRules:
 
 
 class TestShardedMultistep:
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_multistep_matches_sequential_on_mesh(self):
         """steps_per_dispatch over a dp×model mesh: one K-step scanned
         dispatch must match K sequential sharded dispatches."""

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _SNIPPET = """
 import sys
 from absl import flags
@@ -97,6 +99,7 @@ def test_presets_match_benchmark_configs():
             assert preset.get("sequence_length") == seq, name
 
 
+@pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
 def test_serve_loop_end_to_end(tmp_path):
     """cli.serve: build a tiny export, pipe mixed raw/JSON/bad requests
     through the loop, get one JSONL response per request with the loop

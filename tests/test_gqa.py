@@ -125,6 +125,7 @@ class TestGqaModel:
         lb, _ = transformer_apply(params, None, ids, cfg_flash)
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4)
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_flash_kernel_grouped_kv_no_repeat(self):
         """Kernel-level GQA (VERDICT r2 next-#6): flash_attention takes
         (B, S, H_kv, D) kv DIRECTLY — the BlockSpec index maps assign each
@@ -157,6 +158,7 @@ class TestGqaModel:
             assert gf.shape == go.shape  # kv grads stay at H_kv heads
             np.testing.assert_allclose(np.asarray(gf), np.asarray(go), atol=1e-4)
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_flash_kernel_mqa_causal_grads(self):
         """Multi-query extreme (H_kv=1) under structural causality."""
         from transformer_tpu.kernels.flash_attention import flash_attention

@@ -163,6 +163,7 @@ class TestTransformer:
             float(m_plain["loss"]), float(m_remat["loss"]), rtol=1e-6
         )
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_remat_dots_policy_matches_full(self):
         """remat_policy='dots' (save matmul outputs, recompute elementwise)
         must produce the same step numerics as the full-recompute policy —

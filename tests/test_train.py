@@ -179,6 +179,7 @@ class _VariedBatches:
 
 
 class TestMultistepDispatch:
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_scan_matches_sequential(self):
         """K optimizer steps inside one jitted scan (steps_per_dispatch)
         must reproduce K separate dispatches: same params, same metric sums
@@ -219,6 +220,7 @@ class TestMultistepDispatch:
             rtol=1e-5,
         )
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_trainer_trajectory_parity(self):
         """A full Trainer.fit with steps_per_dispatch=3 over 7 varied batches
         (groups 3+3+1, final batch a different width → shape-change flush)
@@ -291,6 +293,7 @@ class TestMultistepDispatch:
         with pytest.raises(ValueError, match="enable_function"):
             tr.fit(_FixedBatches(n=2, seed=0))
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_batch_normalization_loss_metric(self):
         """Under loss_normalization='batch' the per-dispatch 'loss' must be
         the mean of the K per-step batch-normalized losses, not the
@@ -350,6 +353,7 @@ class TestEarlyStopping:
         assert any("early stop" in l for l in logs), logs[-3:]
         assert len(done) < 40  # stopped before the epoch budget
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_marker_blocks_relaunch(self, tmp_path):
         """A relaunch after an early stop must not retrain past the stopped
         checkpoint (job-scheduler retries would otherwise overwrite it)."""
@@ -379,6 +383,7 @@ class TestEarlyStopping:
         assert not any("done in" in l for l in relaunch_logs)  # no training
         assert mgr2.all_steps() == saved_steps  # checkpoints untouched
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_plateau_window_survives_resume(self, tmp_path):
         """Crash-resume keeps the patience window (plateau.json sidecar): a
         run preempted after a plateau epoch must NOT get a fresh window and
@@ -443,6 +448,7 @@ class TestEarlyStopping:
         assert len([l for l in logs if "done in" in l]) == 4
         assert not any("early stop" in l for l in logs)
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_disabled_runs_all_epochs(self):
         import dataclasses
 
@@ -724,7 +730,11 @@ class TestChunkedLoss:
         tgt = jnp.asarray(r.integers(1, 28, (4, 9)), jnp.int32)
         return src, tgt
 
-    @pytest.mark.parametrize("chunks", [2, 3])  # 3 does not divide S-1=8
+    @pytest.mark.parametrize(
+        "chunks",
+        [2, pytest.param(3, marks=pytest.mark.slow)],  # 3 does not divide S-1=8;
+        # the non-dividing case is the slow-tier sweep, chunks=2 the fast specimen
+    )
     def test_train_step_matches_monolithic(self, chunks):
         import dataclasses
 
@@ -752,6 +762,7 @@ class TestChunkedLoss:
         m2 = jax.jit(make_eval_step(TINY, tc))(state, src, tgt)
         np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_tied_output_supported(self):
         import dataclasses
 
@@ -886,6 +897,7 @@ class TestTrainStep:
         assert last < 0.4 * first, (first, last)
         assert int(state.step) == 150
 
+    @pytest.mark.slow  # heavyweight: slow tier (fast tier keeps a specimen)
     def test_grad_accum_matches_whole_batch(self):
         """grad_accum_steps=4 must produce the same optimizer trajectory as
         the whole-batch step (dropout off), for both normalizations."""
