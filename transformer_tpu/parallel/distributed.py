@@ -170,8 +170,10 @@ def make_1f1b_train_step(
     bubble at pod scale without blowing HBM.
 
     Supported surface (hard-checked): decoder-only dense models on
-    data x pipe meshes. The GPipe path keeps the wider composition matrix
-    (fsdp ZeRO-3 gather, model-axis GSPMD interiors, MoE aux, chunked loss);
+    data x fsdp x pipe meshes (fsdp composes ZeRO-3 style: layer params stay
+    sharded at rest, gathered one layer at a time inside the stage, grads
+    reduce-scattered by the gather's vjp). The GPipe path keeps the wider
+    matrix (model-axis GSPMD interiors, MoE aux, chunked loss, seq2seq);
     those combinations raise here with a pointer back to pp_schedule=gpipe.
     """
     import jax.numpy as jnp
@@ -184,6 +186,7 @@ def make_1f1b_train_step(
     from transformer_tpu.ops.masks import make_padding_mask
     from transformer_tpu.ops.nn import layernorm_apply
     from transformer_tpu.parallel.pipeline import (
+        _layer_fsdp_specs,
         pipeline_train_1f1b,
         stack_layer_params,
         unstack_layer_params,
@@ -214,14 +217,14 @@ def make_1f1b_train_step(
         )
     unsupported = {
         a: mesh.shape[a]
-        for a in ("fsdp", "model", "seq", "expert")
+        for a in ("model", "seq", "expert")
         if mesh.shape.get(a, 1) > 1
     }
     if unsupported:
         raise ValueError(
-            f"pp_schedule='1f1b' composes with 'data' only, not {unsupported} "
-            "(fsdp/model interiors are wired through the GPipe path; use "
-            "pp_schedule='gpipe')"
+            f"pp_schedule='1f1b' composes with 'data' and 'fsdp', not "
+            f"{unsupported} (model-axis interiors are wired through the "
+            "GPipe path; use pp_schedule='gpipe')"
         )
     if "pipe" not in mesh.shape:
         raise ValueError(
@@ -286,6 +289,7 @@ def make_1f1b_train_step(
             stacked, nonlayer, h0, (tar_inp, tar_out),
             layer_fn, head_fn, 1.0 / denom,
             mesh=mesh, num_microbatches=num_mb, base_rng=r_dec,
+            param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
         )
         (d_pro,) = pro_vjp(d_h0)
         layer_grads = unstack_layer_params(d_stacked, model_cfg.num_layers)

@@ -72,6 +72,21 @@ def _gather_layer(lp: Params, specs: Params | None, fsdp_axis: str) -> Params:
     return jax.tree.map(gather, lp, specs, is_leaf=lambda x: x is None)
 
 
+def _stacked_params_spec(
+    stacked_params: Params, param_specs: Params | None, axis: str
+) -> Params:
+    """shard_map specs for stage-stacked layer params: leading layer dim on
+    ``axis``, plus any interior fsdp dims from ``param_specs`` (shared by the
+    GPipe and 1F1B paths so their at-rest layouts cannot diverge)."""
+    if param_specs is None:
+        return jax.tree.map(lambda _: P(axis), stacked_params)
+    return jax.tree.map(
+        lambda spec: P(axis) if spec is None else P(axis, *spec),
+        param_specs,
+        is_leaf=lambda s: isinstance(s, P) or s is None,
+    )
+
+
 def pipeline_apply(
     stacked_params: Params,
     layer_fn: Callable[..., jax.Array],
@@ -128,14 +143,7 @@ def pipeline_apply(
         )
     batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
 
-    if param_specs is None:
-        params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
-    else:
-        params_spec = jax.tree.map(
-            lambda spec: P(axis) if spec is None else P(axis, *spec),
-            param_specs,
-            is_leaf=lambda s: isinstance(s, P) or s is None,
-        )
+    params_spec = _stacked_params_spec(stacked_params, param_specs, axis)
     bspec = P(batch_axes)  # batch dim sharded, rest replicated
     consts_spec = tuple(P(batch_axes) for _ in mb_consts)
     rng_spec = P()
@@ -449,7 +457,9 @@ def pipeline_train_1f1b(
     num_microbatches: int,
     base_rng: jax.Array | None = None,
     axis: str = "pipe",
-    batch_axes: tuple[str, ...] = ("data",),
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    param_specs: Params | None = None,
+    fsdp_axis: str = "fsdp",
 ) -> tuple[dict, jax.Array, Params, Params]:
     """One fused forward+backward pass of a homogeneous layer stack under the
     non-interleaved 1F1B schedule, returning loss sums and gradients.
@@ -507,7 +517,13 @@ def pipeline_train_1f1b(
         )
     batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
 
-    params_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    # fsdp composition (ZeRO-3): layer leaves stay fsdp-sharded at rest and
+    # are all-gathered one layer at a time inside stage_fwd; the gather's
+    # vjp is a reduce_scatter, which both SUMS gradient contributions
+    # across the fsdp shards (each holds different microbatch rows — fsdp
+    # is a batch axis too) and re-shards them to the at-rest layout. Same
+    # machinery as the GPipe path.
+    params_spec = _stacked_params_spec(stacked_params, param_specs, axis)
     nonlayer_spec = jax.tree.map(lambda _: P(), nonlayer_params)
     bspec = P(batch_axes)
     streams_spec = tuple(P(batch_axes) for _ in mb_streams)
@@ -549,6 +565,9 @@ def pipeline_train_1f1b(
         def stage_fwd(lp, h, mb_idx, streams_mb):
             def one_layer(h, xs):
                 local_i, layer_p = xs
+                # ZeRO-3: gather this one layer's fsdp-sharded leaves to
+                # full arrays just-in-time (no-op when param_specs is None).
+                layer_p = _gather_layer(layer_p, param_specs, fsdp_axis)
                 if base_rng is None:
                     r = None
                 else:
@@ -645,9 +664,28 @@ def pipeline_train_1f1b(
         sums = {k: jax.lax.psum(v, reduce_axes) for k, v in sums.items()}
         d_non = jax.tree.map(lambda g: jax.lax.psum(g, reduce_axes), d_non)
         if batch_axes:
-            d_stk = jax.tree.map(
-                lambda g: jax.lax.psum(g, batch_axes), d_stk
-            )
+            if param_specs is None:
+                d_stk = jax.tree.map(
+                    lambda g: jax.lax.psum(g, batch_axes), d_stk
+                )
+            else:
+                # Per-leaf reduction: a leaf sharded over fsdp already had
+                # its fsdp-sum done by the gather's reduce_scatter transpose
+                # (each shard now holds ITS slice of the summed grads) —
+                # psum'ing it over fsdp again would add different slices.
+                # Replicated leaves still need the full batch-axes sum.
+                def reduce_leaf(g, spec):
+                    sharded = spec is not None and fsdp_axis in tuple(spec)
+                    axes = tuple(
+                        a for a in batch_axes
+                        if not (sharded and a == fsdp_axis)
+                    )
+                    return jax.lax.psum(g, axes) if axes else g
+
+                d_stk = jax.tree.map(
+                    reduce_leaf, d_stk, param_specs,
+                    is_leaf=lambda x: x is None,
+                )
         return sums, d_h0, d_stk, d_non
 
     rng_in = base_rng if base_rng is not None else jax.random.PRNGKey(0)
