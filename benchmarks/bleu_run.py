@@ -41,9 +41,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--config", default="base", choices=["tiny", "small", "base"],
-        help="tiny/small are CPU-fallback scales; base is the headline "
-        "Transformer-base run",
+        "--config", default="base", choices=["tiny", "small", "medium", "base"],
+        help="tiny/small/medium are CPU-fallback scales (medium = 4L/256, "
+        "the next capacity step of the capacity+smoothing recipe the r3 2x2 "
+        "showed compounds); base is the headline Transformer-base run",
     )
     ap.add_argument("--epochs", type=int, default=40)
     ap.add_argument("--warmup", type=int, default=2000)
@@ -154,6 +155,7 @@ def main() -> None:
     shapes = {
         "tiny": dict(num_layers=2, d_model=128, num_heads=4, dff=512),
         "small": dict(num_layers=2, d_model=256, num_heads=8, dff=1024),
+        "medium": dict(num_layers=4, d_model=256, num_heads=8, dff=1024),
         "base": dict(num_layers=6, d_model=512, num_heads=8, dff=2048),
     }[args.config]
     model_cfg = ModelConfig(

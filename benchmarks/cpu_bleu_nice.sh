@@ -1,0 +1,25 @@
+#!/bin/bash
+# Run a CPU-fallback BLEU convergence run that YIELDS the single host core
+# to TPU measurements: while the watchdog holds .tpu_busy, the training
+# process is SIGSTOPped (a paused trainer skews nothing; a running one
+# skews every TPU timing loop on this 1-core host). Resumable like every
+# bleu_run invocation. Usage: benchmarks/cpu_bleu_nice.sh <config> <epochs> <out> <err>
+cd "$(dirname "$0")/.." || exit 1
+CFG=${1:-medium}; EPOCHS=${2:-60}; OUT=${3:-bleu_${CFG}_ls_cpu.jsonl}; ERR=${4:-bleu_${CFG}_ls.err}
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  nice -n 10 python benchmarks/bleu_run.py --config "$CFG" --epochs "$EPOCHS" \
+  --vocab 8192 --dtype float32 --warmup 1000 --label_smoothing 0.1 \
+  --bleu_every 10 >>"$OUT" 2>>"$ERR" &
+PID=$!
+echo "bleu $CFG run pid $PID" >>"$ERR"
+STOPPED=0
+while kill -0 "$PID" 2>/dev/null; do
+  if [ -e .tpu_busy ] && [ "$STOPPED" = 0 ]; then
+    kill -STOP "$PID"; STOPPED=1
+  elif [ ! -e .tpu_busy ] && [ "$STOPPED" = 1 ]; then
+    kill -CONT "$PID"; STOPPED=0
+  fi
+  sleep 15
+done
+wait "$PID"
+echo "bleu $CFG run exited rc=$?" >>"$ERR"

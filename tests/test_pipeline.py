@@ -518,16 +518,33 @@ class Test1F1B:
 
         np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=2e-4)
 
-    def test_grads_match_single_device(self):
+    @pytest.mark.parametrize(
+        "mesh_kwargs,tcfg_kwargs",
+        [
+            (dict(data=2, pipe=2), dict()),
+            # fsdp composition: the ZeRO-3 per-layer gather inside the 1f1b
+            # stage must still reproduce single-device gradients — the
+            # gather's vjp (reduce_scatter) both sums over the fsdp batch
+            # shards and re-shards, and the engine must not double-reduce
+            # those leaves.
+            (
+                dict(data=2, fsdp=2, pipe=2),
+                dict(batch_size=8, pp_microbatches=2),
+            ),
+        ],
+        ids=["data_pipe", "data_fsdp_pipe"],
+    )
+    def test_grads_match_single_device(self, mesh_kwargs, tcfg_kwargs):
         """One step with SGD(1.0): the param delta IS the gradient, so this
-        pins every 1f1b gradient leaf against the plain single-device step."""
+        pins every 1f1b gradient leaf against the plain single-device step,
+        for each supported mesh composition."""
         import optax
 
         from transformer_tpu.parallel import create_sharded_state, put_batch
         from transformer_tpu.parallel.distributed import make_1f1b_train_step
         from transformer_tpu.train import create_train_state, make_train_step
 
-        tc = self._tcfg(pp_schedule="1f1b")
+        tc = self._tcfg(pp_schedule="1f1b", **tcfg_kwargs)
         tgt = self._batch()
         rng = jax.random.PRNGKey(42)
         sgd = optax.sgd(1.0)
@@ -540,49 +557,8 @@ class Test1F1B:
             lambda a, b: np.asarray(a) - np.asarray(b), state.params, s2.params
         )
 
-        mesh = make_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
-        sstate, _ = create_sharded_state(
-            jax.random.PRNGKey(0), self.MODEL, tc, mesh
-        )
-        step = jax.jit(make_1f1b_train_step(mesh, self.MODEL, tc, tx=sgd))
-        s3, m_1f1b = step(sstate, put_batch(tgt, mesh), put_batch(tgt, mesh), rng)
-        g_1f1b = jax.tree.map(
-            lambda a, b: np.asarray(a) - np.asarray(b), sstate.params, s3.params
-        )
-
-        np.testing.assert_allclose(
-            float(m_1f1b["loss"]), float(m_ref["loss"]), rtol=1e-5
-        )
-        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_1f1b)):
-            np.testing.assert_allclose(
-                np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-4
-            )
-
-    def test_fsdp_composition_grads_match(self):
-        """data=2 x fsdp=2 x pipe=2: the ZeRO-3 per-layer gather inside the
-        1f1b stage must still reproduce single-device gradients — the
-        gather's vjp (reduce_scatter) both sums over the fsdp batch shards
-        and re-shards, and the engine must not double-reduce those leaves."""
-        import optax
-
-        from transformer_tpu.parallel import create_sharded_state, put_batch
-        from transformer_tpu.parallel.distributed import make_1f1b_train_step
-        from transformer_tpu.train import create_train_state, make_train_step
-
-        tc = self._tcfg(pp_schedule="1f1b", batch_size=8, pp_microbatches=2)
-        tgt = self._batch()
-        rng = jax.random.PRNGKey(42)
-        sgd = optax.sgd(1.0)
-
-        state = create_train_state(jax.random.PRNGKey(0), self.MODEL, tc)
-        s2, m_ref = jax.jit(make_train_step(self.MODEL, tc, tx=sgd))(
-            state, tgt, tgt, rng
-        )
-        g_ref = jax.tree.map(
-            lambda a, b: np.asarray(a) - np.asarray(b), state.params, s2.params
-        )
-
-        mesh = make_mesh(MeshConfig(data=2, fsdp=2, pipe=2))
+        cfg = MeshConfig(**mesh_kwargs)
+        mesh = make_mesh(cfg, devices=jax.devices()[: cfg.num_devices])
         sstate, _ = create_sharded_state(
             jax.random.PRNGKey(0), self.MODEL, tc, mesh
         )
