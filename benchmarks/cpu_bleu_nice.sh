@@ -1,9 +1,11 @@
 #!/bin/bash
 # Run a CPU-fallback BLEU convergence run that YIELDS the single host core
 # to TPU measurements: while the watchdog holds .tpu_busy, the training
-# process is SIGSTOPped (a paused trainer skews nothing; a running one
-# skews every TPU timing loop on this 1-core host). Resumable like every
-# bleu_run invocation. Usage: benchmarks/cpu_bleu_nice.sh <config> <epochs> <out> <err>
+# process is SIGSTOPped (a paused trainer cannot skew TPU timing loops on
+# this 1-core host). CAVEAT: bleu_run's published train_seconds is
+# wall-clock, so pause time inflates it — total paused seconds are logged
+# to the err file for correction. Resumable like every bleu_run
+# invocation. Usage: benchmarks/cpu_bleu_nice.sh <config> <epochs> <out> <err>
 cd "$(dirname "$0")/.." || exit 1
 CFG=${1:-medium}; EPOCHS=${2:-60}; OUT=${3:-bleu_${CFG}_ls_cpu.jsonl}; ERR=${4:-bleu_${CFG}_ls.err}
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -11,15 +13,20 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   --vocab 8192 --dtype float32 --warmup 1000 --label_smoothing 0.1 \
   --bleu_every 10 >>"$OUT" 2>>"$ERR" &
 PID=$!
+# Never leave the trainer orphaned in stopped state: a SIGSTOPped process
+# cannot even receive SIGTERM until continued.
+trap 'kill -CONT "$PID" 2>/dev/null' EXIT
 echo "bleu $CFG run pid $PID" >>"$ERR"
 STOPPED=0
+PAUSED_S=0
 while kill -0 "$PID" 2>/dev/null; do
   if [ -e .tpu_busy ] && [ "$STOPPED" = 0 ]; then
     kill -STOP "$PID"; STOPPED=1
   elif [ ! -e .tpu_busy ] && [ "$STOPPED" = 1 ]; then
     kill -CONT "$PID"; STOPPED=0
   fi
+  [ "$STOPPED" = 1 ] && PAUSED_S=$((PAUSED_S + 15))
   sleep 15
 done
 wait "$PID"
-echo "bleu $CFG run exited rc=$?" >>"$ERR"
+echo "bleu $CFG run exited rc=$? (paused ~${PAUSED_S}s total; subtract from train_seconds)" >>"$ERR"
