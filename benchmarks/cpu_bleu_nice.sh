@@ -15,7 +15,7 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 PID=$!
 # Never leave the trainer orphaned in stopped state: a SIGSTOPped process
 # cannot even receive SIGTERM until continued.
-trap 'kill -CONT "$PID" 2>/dev/null' EXIT
+trap 'kill -CONT "$PID" 2>/dev/null' EXIT INT TERM
 echo "bleu $CFG run pid $PID" >>"$ERR"
 STOPPED=0
 PAUSED_S=0

@@ -531,8 +531,23 @@ class Test1F1B:
                 dict(data=2, fsdp=2, pipe=2),
                 dict(batch_size=8, pp_microbatches=2),
             ),
+            # model axis stays GSPMD-auto: stage interiors keep heads/dff
+            # sharding through the engine's internal vjps.
+            (
+                dict(data=2, model=2, pipe=2),
+                dict(batch_size=8, pp_microbatches=2),
+            ),
+            # the full advertised surface in ONE mesh: fsdp gather x
+            # auto-model interiors x manual pipe schedule together.
+            (
+                dict(fsdp=2, model=2, pipe=2),
+                dict(batch_size=8, pp_microbatches=2),
+            ),
         ],
-        ids=["data_pipe", "data_fsdp_pipe"],
+        ids=[
+            "data_pipe", "data_fsdp_pipe", "data_model_pipe",
+            "fsdp_model_pipe",
+        ],
     )
     def test_grads_match_single_device(self, mesh_kwargs, tcfg_kwargs):
         """One step with SGD(1.0): the param delta IS the gradient, so this
@@ -620,11 +635,11 @@ class Test1F1B:
             make_1f1b_train_step(
                 mesh, self.MODEL, dataclasses.replace(tc, grad_accum_steps=2)
             )
-        model_mesh = make_mesh(
-            MeshConfig(data=1, model=2, pipe=2), devices=jax.devices()[:4]
+        seq_mesh = make_mesh(
+            MeshConfig(data=1, seq=2, pipe=2), devices=jax.devices()[:4]
         )
-        with pytest.raises(ValueError, match="composes with 'data' and 'fsdp'"):
-            make_1f1b_train_step(model_mesh, self.MODEL, tc)
+        with pytest.raises(ValueError, match="composes with 'data', 'fsdp'"):
+            make_1f1b_train_step(seq_mesh, self.MODEL, tc)
         # Unknown schedule names are rejected at TrainConfig construction.
         with pytest.raises(ValueError, match="pp_schedule"):
             self._tcfg(pp_schedule="zigzag")

@@ -244,7 +244,8 @@ class TrainConfig:
     # interleaved forward/backward schedule, stash bounded at 2*stages-1
     # microbatches regardless of pp_microbatches — the pod-scale memory
     # profile). 1f1b currently supports decoder-only dense models on
-    # data x fsdp x pipe meshes (parallel/pipeline.py pipeline_train_1f1b).
+    # data x fsdp x model x pipe meshes (parallel/pipeline.py
+    # pipeline_train_1f1b).
     pp_schedule: str = "gpipe"
     # Gradient accumulation: split each batch into this many sequential
     # micro-steps and sum gradients before one optimizer update — train

@@ -170,11 +170,12 @@ def make_1f1b_train_step(
     bubble at pod scale without blowing HBM.
 
     Supported surface (hard-checked): decoder-only dense models on
-    data x fsdp x pipe meshes (fsdp composes ZeRO-3 style: layer params stay
-    sharded at rest, gathered one layer at a time inside the stage, grads
-    reduce-scattered by the gather's vjp). The GPipe path keeps the wider
-    matrix (model-axis GSPMD interiors, MoE aux, chunked loss, seq2seq);
-    those combinations raise here with a pointer back to pp_schedule=gpipe.
+    data x fsdp x model x pipe meshes — fsdp composes ZeRO-3 style (layer
+    params stay sharded at rest, gathered one layer at a time inside the
+    stage, grads reduce-scattered by the gather's vjp) and the model axis
+    stays GSPMD-auto (stage interiors keep heads/dff sharding through the
+    engine's internal vjps). GPipe keeps MoE aux, chunked loss, and
+    seq2seq; those raise here with a pointer back to pp_schedule=gpipe.
     """
     import jax.numpy as jnp
     import optax
@@ -217,14 +218,15 @@ def make_1f1b_train_step(
         )
     unsupported = {
         a: mesh.shape[a]
-        for a in ("model", "seq", "expert")
+        for a in ("seq", "expert")
         if mesh.shape.get(a, 1) > 1
     }
     if unsupported:
         raise ValueError(
-            f"pp_schedule='1f1b' composes with 'data' and 'fsdp', not "
-            f"{unsupported} (model-axis interiors are wired through the "
-            "GPipe path; use pp_schedule='gpipe')"
+            f"pp_schedule='1f1b' composes with 'data', 'fsdp' and 'model', "
+            f"not {unsupported} (the seq/expert shard_map contexts cannot "
+            "fire inside the 1f1b manual region — same rejection as GPipe; "
+            "use a non-pipe mesh for those axes)"
         )
     if "pipe" not in mesh.shape:
         raise ValueError(
@@ -290,6 +292,12 @@ def make_1f1b_train_step(
             layer_fn, head_fn, 1.0 / denom,
             mesh=mesh, num_microbatches=num_mb, base_rng=r_dec,
             param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
+            # Tensor parallelism composes by exclusion, like GPipe: the
+            # model axis stays GSPMD-auto so stage interiors keep their
+            # heads/dff sharding through the engine's internal vjps.
+            auto_axes=(
+                ("model",) if mesh.shape.get("model", 1) > 1 else ()
+            ),
         )
         (d_pro,) = pro_vjp(d_h0)
         layer_grads = unstack_layer_params(d_stacked, model_cfg.num_layers)

@@ -460,9 +460,16 @@ def pipeline_train_1f1b(
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
     param_specs: Params | None = None,
     fsdp_axis: str = "fsdp",
+    auto_axes: tuple[str, ...] = (),
 ) -> tuple[dict, jax.Array, Params, Params]:
     """One fused forward+backward pass of a homogeneous layer stack under the
     non-interleaved 1F1B schedule, returning loss sums and gradients.
+
+    ``auto_axes`` composes tensor parallelism exactly like ``pipeline_apply``:
+    pass ``("model",)`` to keep that axis OUT of the manual region — stage
+    interiors (and the loss head's vocab projection) stay model-axis-sharded
+    with XLA-inserted collectives, including through the engine's internal
+    ``jax.vjp``s, while the schedule's ppermute/psum ride the manual axes.
 
     The engine is its own autodiff: ``jax.grad`` over the GPipe scan must
     finish ALL forwards before its transposed backward starts (that is what
@@ -533,6 +540,7 @@ def pipeline_train_1f1b(
     S_buf = one_f1b_stash_slots(n_stages)
     layers_per_stage = num_layers // n_stages
     sums_spec = {"loss_sum": P(), "weight": P(), "correct": P()}
+    manual = tuple(a for a in mesh.axis_names if a not in auto_axes)
 
     @functools.partial(
         shard_map,
@@ -545,7 +553,7 @@ def pipeline_train_1f1b(
             nonlayer_spec,
         ),
         check_vma=False,
-        axis_names=set(mesh.axis_names),
+        axis_names=set(manual),
     )
     def _engine(local_params, nonlayer, h0_local, streams_local, rng, inv_d):
         batch = h0_local.shape[0]
