@@ -485,7 +485,8 @@ class Test1F1B:
         assert one_f1b_stash_slots(4) == 7  # ...while the stash stays put
         assert one_f1b_ticks(4, 1) == 4  # P=1 degenerates to grad accum
 
-    def test_matches_gpipe_losses(self):
+    @pytest.mark.parametrize("decoder_only", [True, False], ids=["lm", "seq2seq"])
+    def test_matches_gpipe_losses(self, decoder_only):
         """Same config, same data: 1f1b and gpipe training losses track each
         other step for step (params are compared via the trajectory, not
         directly — Adam amplifies fp-order gradient noise on near-zero-
@@ -496,7 +497,9 @@ class Test1F1B:
             create_sharded_state, make_sharded_steps, put_batch,
         )
 
+        model = dataclasses.replace(self.MODEL, decoder_only=decoder_only)
         tgt = self._batch()
+        src = self._batch() if not decoder_only else tgt
         rng = jax.random.PRNGKey(42)
 
         def run(schedule, n=3):
@@ -505,13 +508,13 @@ class Test1F1B:
                 MeshConfig(data=2, pipe=2), devices=jax.devices()[:4]
             )
             state, sh = create_sharded_state(
-                jax.random.PRNGKey(0), self.MODEL, tc, mesh
+                jax.random.PRNGKey(0), model, tc, mesh
             )
-            step, _ = make_sharded_steps(mesh, self.MODEL, tc, sh, donate=False)
+            step, _ = make_sharded_steps(mesh, model, tc, sh, donate=False)
             out = []
             for _ in range(n):
                 state, m = step(
-                    state, put_batch(tgt, mesh), put_batch(tgt, mesh), rng
+                    state, put_batch(src, mesh), put_batch(tgt, mesh), rng
                 )
                 out.append(float(m["loss"]))
             return out
@@ -519,9 +522,9 @@ class Test1F1B:
         np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=2e-4)
 
     @pytest.mark.parametrize(
-        "mesh_kwargs,tcfg_kwargs",
+        "mesh_kwargs,tcfg_kwargs,decoder_only",
         [
-            (dict(data=2, pipe=2), dict()),
+            (dict(data=2, pipe=2), dict(), True),
             # fsdp composition: the ZeRO-3 per-layer gather inside the 1f1b
             # stage must still reproduce single-device gradients — the
             # gather's vjp (reduce_scatter) both sums over the fsdp batch
@@ -530,43 +533,60 @@ class Test1F1B:
             (
                 dict(data=2, fsdp=2, pipe=2),
                 dict(batch_size=8, pp_microbatches=2),
+                True,
             ),
             # model axis stays GSPMD-auto: stage interiors keep heads/dff
             # sharding through the engine's internal vjps.
             (
                 dict(data=2, model=2, pipe=2),
                 dict(batch_size=8, pp_microbatches=2),
+                True,
             ),
             # the full advertised surface in ONE mesh: fsdp gather x
             # auto-model interiors x manual pipe schedule together.
             (
                 dict(fsdp=2, model=2, pipe=2),
                 dict(batch_size=8, pp_microbatches=2),
+                True,
+            ),
+            # seq2seq hybrid: decoder stack on the 1f1b engine (encoder
+            # output as a gradient stream), encoder stack on GPipe+autodiff.
+            (dict(data=2, pipe=2), dict(), False),
+            (
+                dict(fsdp=2, model=2, pipe=2),
+                dict(batch_size=8, pp_microbatches=2),
+                False,
             ),
         ],
         ids=[
             "data_pipe", "data_fsdp_pipe", "data_model_pipe",
-            "fsdp_model_pipe",
+            "fsdp_model_pipe", "seq2seq_data_pipe", "seq2seq_fsdp_model_pipe",
         ],
     )
-    def test_grads_match_single_device(self, mesh_kwargs, tcfg_kwargs):
+    def test_grads_match_single_device(
+        self, mesh_kwargs, tcfg_kwargs, decoder_only
+    ):
         """One step with SGD(1.0): the param delta IS the gradient, so this
         pins every 1f1b gradient leaf against the plain single-device step,
-        for each supported mesh composition."""
+        for each supported mesh composition and model family."""
+        import dataclasses
+
         import optax
 
         from transformer_tpu.parallel import create_sharded_state, put_batch
         from transformer_tpu.parallel.distributed import make_1f1b_train_step
         from transformer_tpu.train import create_train_state, make_train_step
 
+        model = dataclasses.replace(self.MODEL, decoder_only=decoder_only)
         tc = self._tcfg(pp_schedule="1f1b", **tcfg_kwargs)
         tgt = self._batch()
+        src = self._batch() if not decoder_only else tgt
         rng = jax.random.PRNGKey(42)
         sgd = optax.sgd(1.0)
 
-        state = create_train_state(jax.random.PRNGKey(0), self.MODEL, tc)
-        s2, m_ref = jax.jit(make_train_step(self.MODEL, tc, tx=sgd))(
-            state, tgt, tgt, rng
+        state = create_train_state(jax.random.PRNGKey(0), model, tc)
+        s2, m_ref = jax.jit(make_train_step(model, tc, tx=sgd))(
+            state, src, tgt, rng
         )
         g_ref = jax.tree.map(
             lambda a, b: np.asarray(a) - np.asarray(b), state.params, s2.params
@@ -575,10 +595,12 @@ class Test1F1B:
         cfg = MeshConfig(**mesh_kwargs)
         mesh = make_mesh(cfg, devices=jax.devices()[: cfg.num_devices])
         sstate, _ = create_sharded_state(
-            jax.random.PRNGKey(0), self.MODEL, tc, mesh
+            jax.random.PRNGKey(0), model, tc, mesh
         )
-        step = jax.jit(make_1f1b_train_step(mesh, self.MODEL, tc, tx=sgd))
-        s3, m_1f1b = step(sstate, put_batch(tgt, mesh), put_batch(tgt, mesh), rng)
+        step = jax.jit(make_1f1b_train_step(mesh, model, tc, tx=sgd))
+        s3, m_1f1b = step(
+            sstate, put_batch(src, mesh), put_batch(tgt, mesh), rng
+        )
         g_1f1b = jax.tree.map(
             lambda a, b: np.asarray(a) - np.asarray(b), sstate.params, s3.params
         )
@@ -624,9 +646,11 @@ class Test1F1B:
 
         mesh = make_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
         tc = self._tcfg(pp_schedule="1f1b")
-        seq2seq = dataclasses.replace(self.MODEL, decoder_only=False)
-        with pytest.raises(ValueError, match="decoder-only"):
-            make_1f1b_train_step(mesh, seq2seq, tc)
+        moe = dataclasses.replace(
+            self.MODEL, moe_experts=4, num_heads=2, dff=32
+        )
+        with pytest.raises(ValueError, match="MoE"):
+            make_1f1b_train_step(mesh, moe, tc)
         with pytest.raises(ValueError, match="loss_chunks"):
             make_1f1b_train_step(
                 mesh, self.MODEL, dataclasses.replace(tc, loss_chunks=2)

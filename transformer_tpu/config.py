@@ -243,8 +243,9 @@ class TrainConfig:
     # activation stash grows with pp_microbatches) or "1f1b" (manual
     # interleaved forward/backward schedule, stash bounded at 2*stages-1
     # microbatches regardless of pp_microbatches — the pod-scale memory
-    # profile). 1f1b currently supports decoder-only dense models on
-    # data x fsdp x model x pipe meshes (parallel/pipeline.py
+    # profile). 1f1b supports dense models (decoder-only and seq2seq —
+    # the seq2seq decoder stack runs the engine, the encoder half GPipe)
+    # on data x fsdp x model x pipe meshes (parallel/pipeline.py
     # pipeline_train_1f1b).
     pp_schedule: str = "gpipe"
     # Gradient accumulation: split each batch into this many sequential

@@ -169,25 +169,31 @@ def make_1f1b_train_step(
     O(microbatches), which is what lets pp_microbatches grow to shrink the
     bubble at pod scale without blowing HBM.
 
-    Supported surface (hard-checked): decoder-only dense models on
-    data x fsdp x model x pipe meshes — fsdp composes ZeRO-3 style (layer
-    params stay sharded at rest, gathered one layer at a time inside the
-    stage, grads reduce-scattered by the gather's vjp) and the model axis
-    stays GSPMD-auto (stage interiors keep heads/dff sharding through the
-    engine's internal vjps). GPipe keeps MoE aux, chunked loss, and
-    seq2seq; those raise here with a pointer back to pp_schedule=gpipe.
+    Supported surface (hard-checked): dense models on data x fsdp x model x
+    pipe meshes — fsdp composes ZeRO-3 style (layer params stay sharded at
+    rest, gathered one layer at a time inside the stage, grads
+    reduce-scattered by the gather's vjp) and the model axis stays
+    GSPMD-auto (stage interiors keep heads/dff sharding through the
+    engine's internal vjps). Seq2seq runs a HYBRID: the decoder stack (the
+    3-sublayer half that dominates memory) runs the 1F1B engine with the
+    encoder output as a gradient stream, while the encoder stack runs the
+    GPipe forward with its autodiff backward (its activation stash stays
+    O(microbatches); the decoder's is O(stages)). GPipe keeps MoE aux and
+    chunked loss; those raise here with a pointer back to
+    pp_schedule=gpipe.
     """
     import jax.numpy as jnp
     import optax
 
     from transformer_tpu.config import PAD_ID
     from transformer_tpu.models.decoder import decoder_layer_apply
-    from transformer_tpu.models.encoder import embed_prologue
+    from transformer_tpu.models.encoder import embed_prologue, encoder_layer_apply
     from transformer_tpu.models.transformer import project_logits
     from transformer_tpu.ops.masks import make_padding_mask
     from transformer_tpu.ops.nn import layernorm_apply
     from transformer_tpu.parallel.pipeline import (
         _layer_fsdp_specs,
+        pipeline_apply,
         pipeline_train_1f1b,
         stack_layer_params,
         unstack_layer_params,
@@ -195,12 +201,6 @@ def make_1f1b_train_step(
     from transformer_tpu.train.loss import masked_cross_entropy
     from transformer_tpu.train.trainer import _shift_targets
 
-    if not model_cfg.decoder_only:
-        raise ValueError(
-            "pp_schedule='1f1b' currently supports decoder-only models; "
-            "seq2seq needs the chained encoder/decoder backward — use "
-            "pp_schedule='gpipe'"
-        )
     if model_cfg.moe_experts:
         raise ValueError(
             "pp_schedule='1f1b' does not carry the MoE aux loss through its "
@@ -237,17 +237,32 @@ def make_1f1b_train_step(
     tx = tx or make_optimizer(model_cfg, train_cfg)
     num_mb = train_cfg.pp_microbatches or mesh.shape["pipe"]
 
-    def layer_fn(lp, h, r, ti_mb, to_mb):
-        smask = make_padding_mask(ti_mb, PAD_ID)
-        out = decoder_layer_apply(
-            lp, h, None, smask, None, model_cfg, r, r is None
-        )
-        return out[0]
+    seq2seq = not model_cfg.decoder_only
+    # Tensor parallelism composes by exclusion, like GPipe: the model axis
+    # stays GSPMD-auto so stage interiors keep their heads/dff sharding
+    # through the engine's internal vjps.
+    auto = ("model",) if mesh.shape.get("model", 1) > 1 else ()
+
+    if seq2seq:
+        def layer_fn(lp, h, r, enc_mb, src_mb, ti_mb, to_mb):
+            smask = make_padding_mask(ti_mb, PAD_ID)
+            cmask = make_padding_mask(src_mb, PAD_ID)
+            out = decoder_layer_apply(
+                lp, h, enc_mb, smask, cmask, model_cfg, r, r is None
+            )
+            return out[0]
+    else:
+        def layer_fn(lp, h, r, ti_mb, to_mb):
+            smask = make_padding_mask(ti_mb, PAD_ID)
+            out = decoder_layer_apply(
+                lp, h, None, smask, None, model_cfg, r, r is None
+            )
+            return out[0]
 
     if model_cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    def head_fn(nonlayer, h_mb, ti_mb, to_mb, inv_d):
+    def _head(nonlayer, h_mb, to_mb, inv_d):
         if model_cfg.norm_scheme == "pre":
             h_mb = layernorm_apply(
                 nonlayer["decoder"]["final_ln"], h_mb, model_cfg.layernorm_epsilon
@@ -266,18 +281,64 @@ def make_1f1b_train_step(
             "correct": m["correct"],
         }
 
+    # Explicit per-branch stream binding (mirrors layer_fn): a positional
+    # "*rest" unpack would silently misread targets if the streams tuple
+    # built in train_step ever changed order.
+    if seq2seq:
+        def head_fn(nonlayer, h_mb, enc_mb, src_mb, ti_mb, to_mb, inv_d):
+            return _head(nonlayer, h_mb, to_mb, inv_d)
+    else:
+        def head_fn(nonlayer, h_mb, ti_mb, to_mb, inv_d):
+            return _head(nonlayer, h_mb, to_mb, inv_d)
+
     def train_step(state: TrainState, src, tgt, rng):
         tar_inp, tar_out = _shift_targets(tgt)
         step_rng = jax.random.fold_in(rng, state.step)
-        # Same 4-way split as pipelined_transformer_apply, so the
-        # decoder-only rng streams line up with the GPipe path.
-        _, r_embed_d, _, r_dec = jax.random.split(step_rng, 4)
+        # Same 4-way split as pipelined_transformer_apply, so the rng
+        # streams line up with the GPipe path.
+        r_embed_e, r_embed_d, r_enc, r_dec = jax.random.split(step_rng, 4)
         weight = jnp.sum((tar_out != PAD_ID).astype(jnp.float32))
         if train_cfg.loss_normalization == "tokens":
             denom = jnp.maximum(weight, 1.0)
         else:  # "batch": the reference's rule, train.py:88
             denom = jnp.float32(train_cfg.batch_size)
         params = state.params
+
+        enc_vjp = None
+        if seq2seq:
+            # Encoder half: GPipe forward with jax.vjp providing its
+            # autodiff backward (stash O(microbatches) for this half; the
+            # decoder half below gets the O(stages) 1f1b stash). The vjp is
+            # seeded later with the decoder engine's d(enc_out) stream.
+            def enc_forward(p):
+                x = embed_prologue(
+                    p["encoder"]["embedding"], src, model_cfg, r_embed_e, False
+                )
+
+                def enc_layer(lp, h, r, emask):
+                    return encoder_layer_apply(
+                        lp, h, emask, model_cfg, r, r is None
+                    )[0]
+
+                if model_cfg.remat:
+                    enc_layer = jax.checkpoint(enc_layer)
+                out = pipeline_apply(
+                    stack_layer_params(p["encoder"]["layers"]),
+                    enc_layer, x, (make_padding_mask(src, PAD_ID),),
+                    mesh=mesh, num_microbatches=num_mb, base_rng=r_enc,
+                    param_specs=_layer_fsdp_specs(
+                        p["encoder"]["layers"][0], mesh
+                    ),
+                    auto_axes=auto,
+                )
+                if model_cfg.norm_scheme == "pre":
+                    out = layernorm_apply(
+                        p["encoder"]["final_ln"], out,
+                        model_cfg.layernorm_epsilon,
+                    )
+                return out
+
+            enc_out, enc_vjp = jax.vjp(enc_forward, params)
 
         def prologue(p):
             return embed_prologue(
@@ -287,25 +348,47 @@ def make_1f1b_train_step(
         h0, pro_vjp = jax.vjp(prologue, params)
         stacked = stack_layer_params(params["decoder"]["layers"])
         nonlayer = {**params, "decoder": {**params["decoder"], "layers": ()}}
-        sums, d_h0, d_stacked, d_nonlayer = pipeline_train_1f1b(
-            stacked, nonlayer, h0, (tar_inp, tar_out),
+        if seq2seq:
+            # The head never reads the encoder subtree (its real grads come
+            # from enc_vjp outside) — strip it entirely rather than
+            # replicate a vocab-sized embedding into the engine and psum
+            # its zero gradients every step.
+            nonlayer = {k: v for k, v in nonlayer.items() if k != "encoder"}
+            streams = (enc_out, src, tar_inp, tar_out)
+            gs = (0,)  # d(enc_out) comes back to seed the encoder backward
+        else:
+            streams = (tar_inp, tar_out)
+            gs = ()
+        engine_out = pipeline_train_1f1b(
+            stacked, nonlayer, h0, streams,
             layer_fn, head_fn, 1.0 / denom,
             mesh=mesh, num_microbatches=num_mb, base_rng=r_dec,
             param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
-            # Tensor parallelism composes by exclusion, like GPipe: the
-            # model axis stays GSPMD-auto so stage interiors keep their
-            # heads/dff sharding through the engine's internal vjps.
-            auto_axes=(
-                ("model",) if mesh.shape.get("model", 1) > 1 else ()
-            ),
+            auto_axes=auto,
+            grad_streams=gs,
         )
+        if seq2seq:
+            sums, d_h0, d_stacked, d_nonlayer, (d_enc,) = engine_out
+        else:
+            sums, d_h0, d_stacked, d_nonlayer = engine_out
         (d_pro,) = pro_vjp(d_h0)
         layer_grads = unstack_layer_params(d_stacked, model_cfg.num_layers)
         d_engine = {
             **d_nonlayer,
             "decoder": {**d_nonlayer["decoder"], "layers": layer_grads},
         }
+        if seq2seq:
+            # The engine never saw the encoder subtree — restore the full
+            # param structure with zeros (the real encoder grads come from
+            # enc_vjp, which differentiates wrt the FULL param tree).
+            d_engine = {
+                **d_engine,
+                "encoder": jax.tree.map(jnp.zeros_like, params["encoder"]),
+            }
         grads = jax.tree.map(jnp.add, d_pro, d_engine)
+        if seq2seq:
+            (d_enc_params,) = enc_vjp(d_enc.astype(enc_out.dtype))
+            grads = jax.tree.map(jnp.add, grads, d_enc_params)
         metrics = {
             "loss": sums["loss_sum"] / denom,
             "loss_sum": sums["loss_sum"],

@@ -461,7 +461,10 @@ def pipeline_train_1f1b(
     param_specs: Params | None = None,
     fsdp_axis: str = "fsdp",
     auto_axes: tuple[str, ...] = (),
-) -> tuple[dict, jax.Array, Params, Params]:
+    grad_streams: tuple[int, ...] = (),
+) -> tuple[dict, jax.Array, Params, Params] | tuple[
+    dict, jax.Array, Params, Params, tuple[jax.Array, ...]
+]:
     """One fused forward+backward pass of a homogeneous layer stack under the
     non-interleaved 1F1B schedule, returning loss sums and gradients.
 
@@ -470,6 +473,13 @@ def pipeline_train_1f1b(
     interiors (and the loss head's vocab projection) stay model-axis-sharded
     with XLA-inserted collectives, including through the engine's internal
     ``jax.vjp``s, while the schedule's ppermute/psum ride the manual axes.
+
+    ``grad_streams`` names indices into ``mb_streams`` whose cotangents the
+    engine must also return (appended as a fifth tuple element, each shaped
+    and batch-sharded like its stream). This is the seq2seq hook: the
+    decoder stack streams the encoder output into every layer's
+    cross-attention, and its cotangent — accumulated across all decoder
+    stages and microbatches — seeds the encoder backward outside.
 
     The engine is its own autodiff: ``jax.grad`` over the GPipe scan must
     finish ALL forwards before its transposed backward starts (that is what
@@ -541,17 +551,15 @@ def pipeline_train_1f1b(
     layers_per_stage = num_layers // n_stages
     sums_spec = {"loss_sum": P(), "weight": P(), "correct": P()}
     manual = tuple(a for a in mesh.axis_names if a not in auto_axes)
+    out_specs = (sums_spec, bspec, params_spec, nonlayer_spec)
+    if grad_streams:
+        out_specs = out_specs + (tuple(bspec for _ in grad_streams),)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=(params_spec, nonlayer_spec, bspec, streams_spec, P(), P()),
-        out_specs=(
-            sums_spec,
-            bspec,
-            params_spec,
-            nonlayer_spec,
-        ),
+        out_specs=out_specs,
         check_vma=False,
         axis_names=set(manual),
     )
@@ -625,9 +633,20 @@ def pipeline_train_1f1b(
             b_c = jnp.clip(b_mb, 0, M - 1)
             streams_b = tuple(s[b_c] for s in streams_mbs)
             x_in = stash[b_c % S_buf]
+            # The vjp also covers the grad_streams operands (e.g. the
+            # encoder output a decoder stack cross-attends): their per-tick
+            # cotangents ride the scan output and are re-indexed per stage
+            # after it.
+            gs_b = tuple(streams_b[i] for i in grad_streams)
+
+            def fwd_for_vjp(lp, h, gs):
+                merged = list(streams_b)
+                for idx, val in zip(grad_streams, gs):
+                    merged[idx] = val
+                return stage_fwd(lp, h, b_c, tuple(merged))
+
             h_out_rec, stage_vjp = jax.vjp(
-                lambda lp, h: stage_fwd(lp, h, b_c, streams_b),
-                local_params, x_in,
+                fwd_for_vjp, local_params, x_in, gs_b
             )
             # Loss head on the (recomputed) last-stage output: its vjp both
             # seeds the backward chain and yields the head-param grads.
@@ -637,14 +656,17 @@ def pipeline_train_1f1b(
             )
             d_non_mb, d_head_h = head_vjp(jnp.float32(1.0))
             d_out = jnp.where(is_last, d_head_h.astype(bwd_buf.dtype), bwd_buf)
-            d_lp, d_in = stage_vjp(d_out)
+            d_lp, d_in, d_gs = stage_vjp(d_out)
             d_stk = masked_add(d_stk, d_lp, b_valid)
             d_non = masked_add(d_non, d_non_mb, jnp.logical_and(b_valid, is_last))
             sums = masked_add(sums, head_sums, jnp.logical_and(b_valid, is_last))
             bwd_nxt = (
                 jax.lax.ppermute(d_in, axis, bwd_perm) if n_stages > 1 else d_in
             )
-            return (fwd_nxt, bwd_nxt, stash, d_stk, d_non, sums), d_in
+            d_gs = tuple(
+                jnp.where(b_valid, g, 0).astype(g.dtype) for g in d_gs
+            )
+            return (fwd_nxt, bwd_nxt, stash, d_stk, d_non, sums), (d_in, d_gs)
 
         zero_act = jnp.zeros_like(h_mbs[0])
         init = (
@@ -655,7 +677,7 @@ def pipeline_train_1f1b(
             jax.tree.map(jnp.zeros_like, nonlayer),
             {k: jnp.float32(0.0) for k in ("loss_sum", "weight", "correct")},
         )
-        (_, _, _, d_stk, d_non, sums), d_in_ticks = jax.lax.scan(
+        (_, _, _, d_stk, d_non, sums), (d_in_ticks, d_gs_ticks) = jax.lax.scan(
             tick, init, jnp.arange(T)
         )
 
@@ -667,6 +689,21 @@ def pipeline_train_1f1b(
             d_h0_mbs * is_first.astype(d_h0_mbs.dtype), axis
         )
         d_h0 = d_h0_mbs.reshape(batch, *h0_local.shape[1:])
+
+        # grad_streams cotangents: stage s's contribution for microbatch i
+        # sits at tick 2(P-1)+i-s, so a per-stage dynamic slice of length M
+        # (start 2(P-1)-s, traced) re-indexes ticks -> microbatches; psum
+        # over pipe then sums every stage's contribution. Batch-sharded like
+        # the stream itself (no psum over batch axes).
+        d_streams_out = tuple(
+            jax.lax.psum(
+                jax.lax.dynamic_slice_in_dim(
+                    parts, 2 * (n_stages - 1) - stage, M, axis=0
+                ),
+                axis,
+            ).reshape(batch, *parts.shape[2:])
+            for parts in d_gs_ticks
+        )
 
         reduce_axes = (axis,) + batch_axes
         sums = {k: jax.lax.psum(v, reduce_axes) for k, v in sums.items()}
@@ -694,6 +731,8 @@ def pipeline_train_1f1b(
                     reduce_leaf, d_stk, param_specs,
                     is_leaf=lambda x: x is None,
                 )
+        if grad_streams:
+            return sums, d_h0, d_stk, d_non, d_streams_out
         return sums, d_h0, d_stk, d_non
 
     rng_in = base_rng if base_rng is not None else jax.random.PRNGKey(0)
