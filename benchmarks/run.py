@@ -130,6 +130,12 @@ def bench_config(
         make_eval_step,
         make_train_step,
     )
+    from transformer_tpu.utils import enable_compilation_cache
+
+    # One subprocess per measurement (backend-poisoning isolation) means
+    # every row re-compiles; the persistent cache makes repeat rows and
+    # A/B variants pay compile once per distinct executable.
+    enable_compilation_cache()
 
     model_cfg, train_cfg, batch, seq = _configs()[name]
     if mode in ("decode", "decodeq8"):

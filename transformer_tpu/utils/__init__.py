@@ -3,7 +3,12 @@ preemption handling, determinism audits."""
 
 from transformer_tpu.utils.bleu import corpus_bleu
 from transformer_tpu.utils.preemption import PreemptionGuard, tree_checksum
-from transformer_tpu.utils.profiling import Profiler, StepTimer, annotate
+from transformer_tpu.utils.profiling import (
+    Profiler,
+    StepTimer,
+    annotate,
+    enable_compilation_cache,
+)
 from transformer_tpu.utils.tensorboard import SummaryWriter
 
 __all__ = [
@@ -13,5 +18,6 @@ __all__ = [
     "SummaryWriter",
     "annotate",
     "corpus_bleu",
+    "enable_compilation_cache",
     "tree_checksum",
 ]
