@@ -71,6 +71,45 @@ class TestProfiler:
         assert any(files for _, _, files in os.walk(tmp_path / "prof"))
 
 
+class TestCompilationCache:
+    def test_sets_config_and_persists(self, tmp_path):
+        from transformer_tpu.utils import enable_compilation_cache
+
+        old_dir = jax.config.jax_compilation_cache_dir
+        old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        old_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+        try:
+            d = enable_compilation_cache(str(tmp_path / "cache"))
+            assert d == str(tmp_path / "cache")
+            assert jax.config.jax_compilation_cache_dir == d
+            # Sub-second compiles are cheaper to redo than to hash + load;
+            # drop both floors here so the smoke jit below persists.
+            assert jax.config.jax_persistent_cache_min_compile_time_secs == 1.0
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            x = np.arange(8.0, dtype=np.float32)
+            np.testing.assert_allclose(
+                jax.jit(lambda v: v * 3.0 + 1.0)(x), x * 3.0 + 1.0
+            )
+            assert os.path.isdir(d) and os.listdir(d)  # entry written
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", old_size)
+
+    def test_env_override(self, tmp_path, monkeypatch):
+        from transformer_tpu.utils import enable_compilation_cache
+
+        old_dir = jax.config.jax_compilation_cache_dir
+        old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            monkeypatch.setenv("TRANSFORMER_TPU_JAX_CACHE", str(tmp_path / "env"))
+            assert enable_compilation_cache() == str(tmp_path / "env")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
 class TestStepTimer:
     def test_stats(self):
         t = StepTimer(tokens_per_step=100)
