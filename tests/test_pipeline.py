@@ -613,6 +613,145 @@ class Test1F1B:
                 np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-4
             )
 
+    def _moe(self, **kw):
+        import dataclasses
+
+        return dataclasses.replace(self.MODEL, moe_experts=4, **kw)
+
+    def test_moe_grads_match_gpipe(self):
+        """MoE aux through the manual backward: SGD-delta leaf parity
+        against the GPipe+autodiff step on the SAME mesh and microbatching
+        — both make the identical per-microbatch aux approximation, so
+        every gradient leaf (incl. router/expert weights) must agree up to
+        fp order, and the moe_aux metrics must match."""
+        import optax
+
+        from transformer_tpu.parallel import create_sharded_state, put_batch
+        from transformer_tpu.parallel.distributed import (
+            _pipelined_forward, make_1f1b_train_step,
+        )
+        from transformer_tpu.train import make_train_step
+
+        model = self._moe()
+        tc = self._tcfg(pp_schedule="1f1b")
+        mesh = make_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
+        tgt = self._batch()
+        rng = jax.random.PRNGKey(42)
+        sgd = optax.sgd(1.0)
+        x = put_batch(tgt, mesh)
+
+        state, _ = create_sharded_state(jax.random.PRNGKey(0), model, tc, mesh)
+        gp_step = jax.jit(make_train_step(
+            model, self._tcfg(pp_schedule="gpipe"), tx=sgd,
+            forward_fn=_pipelined_forward(
+                mesh, model, self._tcfg(pp_schedule="gpipe")
+            ),
+        ))
+        s_gp, m_gp = gp_step(state, x, x, rng)
+        s_1f, m_1f = jax.jit(make_1f1b_train_step(mesh, model, tc, tx=sgd))(
+            state, x, x, rng
+        )
+        np.testing.assert_allclose(
+            float(m_1f["loss"]), float(m_gp["loss"]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(m_1f["moe_aux"]), float(m_gp["moe_aux"]), rtol=1e-5
+        )
+        assert float(m_1f["moe_aux"]) > 0.0  # the aux actually fired
+        for a, b in zip(
+            jax.tree.leaves(jax.tree.map(
+                lambda p, q: np.asarray(p) - np.asarray(q),
+                state.params, s_gp.params,
+            )),
+            jax.tree.leaves(jax.tree.map(
+                lambda p, q: np.asarray(p) - np.asarray(q),
+                state.params, s_1f.params,
+            )),
+        ):
+            np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-4)
+
+    def test_moe_m1_matches_single_device(self):
+        """With ONE microbatch and no batch sharding the per-microbatch aux
+        approximation vanishes: the engine must reproduce the single-device
+        MoE step exactly — the sharpest pin on the aux gradient seed."""
+        import optax
+
+        from transformer_tpu.parallel import create_sharded_state, put_batch
+        from transformer_tpu.parallel.distributed import make_1f1b_train_step
+        from transformer_tpu.train import create_train_state, make_train_step
+
+        model = self._moe()
+        tc = self._tcfg(pp_schedule="1f1b", pp_microbatches=1)
+        tgt = self._batch()
+        rng = jax.random.PRNGKey(42)
+        sgd = optax.sgd(1.0)
+
+        state = create_train_state(jax.random.PRNGKey(0), model, tc)
+        s2, m_ref = jax.jit(make_train_step(model, tc, tx=sgd))(
+            state, tgt, tgt, rng
+        )
+        mesh = make_mesh(MeshConfig(data=1, pipe=2), devices=jax.devices()[:2])
+        sstate, _ = create_sharded_state(jax.random.PRNGKey(0), model, tc, mesh)
+        s3, m_1f = jax.jit(make_1f1b_train_step(mesh, model, tc, tx=sgd))(
+            sstate, put_batch(tgt, mesh), put_batch(tgt, mesh), rng
+        )
+        np.testing.assert_allclose(
+            float(m_1f["loss"]), float(m_ref["loss"]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(m_1f["moe_aux"]), float(m_ref["moe_aux"]), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree.leaves(jax.tree.map(
+                lambda p, q: np.asarray(p) - np.asarray(q),
+                state.params, s2.params,
+            )),
+            jax.tree.leaves(jax.tree.map(
+                lambda p, q: np.asarray(p) - np.asarray(q),
+                sstate.params, s3.params,
+            )),
+        ):
+            np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-4)
+
+    def test_moe_seq2seq_matches_gpipe_losses(self):
+        """Seq2seq MoE: decoder aux rides the 1f1b engine, encoder aux
+        seeds its GPipe vjp — loss AND moe_aux trajectories must track the
+        all-GPipe schedule."""
+        from transformer_tpu.parallel import (
+            create_sharded_state, make_sharded_steps, put_batch,
+        )
+
+        model = self._moe(decoder_only=False)
+        tgt = self._batch()
+        src = self._batch()
+        rng = jax.random.PRNGKey(42)
+
+        def run(schedule, n=3):
+            tc = self._tcfg(pp_schedule=schedule)
+            mesh = make_mesh(
+                MeshConfig(data=2, pipe=2), devices=jax.devices()[:4]
+            )
+            state, sh = create_sharded_state(
+                jax.random.PRNGKey(0), model, tc, mesh
+            )
+            step, _ = make_sharded_steps(mesh, model, tc, sh, donate=False)
+            out = []
+            for _ in range(n):
+                state, m = step(
+                    state, put_batch(src, mesh), put_batch(tgt, mesh), rng
+                )
+                out.append((float(m["loss"]), float(m["moe_aux"])))
+            return out
+
+        a, b = run("1f1b"), run("gpipe")
+        np.testing.assert_allclose(
+            [x[0] for x in a], [x[0] for x in b], rtol=2e-4
+        )
+        np.testing.assert_allclose(
+            [x[1] for x in a], [x[1] for x in b], rtol=2e-4
+        )
+        assert all(x[1] > 0 for x in a)
+
     def test_pipe4_microbatch8(self):
         """Deeper pipe (4 stages, M=8 > stash slots would be under GPipe):
         the ring stash must recycle correctly once M exceeds 2P-1."""
@@ -646,11 +785,11 @@ class Test1F1B:
 
         mesh = make_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
         tc = self._tcfg(pp_schedule="1f1b")
-        moe = dataclasses.replace(
-            self.MODEL, moe_experts=4, num_heads=2, dff=32
+        mixed_moe = dataclasses.replace(
+            self.MODEL, moe_experts=4, moe_every=2, num_heads=2, dff=32
         )
-        with pytest.raises(ValueError, match="MoE"):
-            make_1f1b_train_step(mesh, moe, tc)
+        with pytest.raises(ValueError, match="homogeneous"):
+            make_1f1b_train_step(mesh, mixed_moe, tc)
         with pytest.raises(ValueError, match="loss_chunks"):
             make_1f1b_train_step(
                 mesh, self.MODEL, dataclasses.replace(tc, loss_chunks=2)

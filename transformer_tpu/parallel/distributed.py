@@ -169,17 +169,21 @@ def make_1f1b_train_step(
     O(microbatches), which is what lets pp_microbatches grow to shrink the
     bubble at pod scale without blowing HBM.
 
-    Supported surface (hard-checked): dense models on data x fsdp x model x
-    pipe meshes — fsdp composes ZeRO-3 style (layer params stay sharded at
-    rest, gathered one layer at a time inside the stage, grads
-    reduce-scattered by the gather's vjp) and the model axis stays
-    GSPMD-auto (stage interiors keep heads/dff sharding through the
-    engine's internal vjps). Seq2seq runs a HYBRID: the decoder stack (the
-    3-sublayer half that dominates memory) runs the 1F1B engine with the
-    encoder output as a gradient stream, while the encoder stack runs the
-    GPipe forward with its autodiff backward (its activation stash stays
-    O(microbatches); the decoder's is O(stages)). GPipe keeps MoE aux and
-    chunked loss; those raise here with a pointer back to
+    Supported surface (hard-checked): dense and homogeneous-MoE
+    (``moe_every == 1``) models on data x fsdp x model x pipe meshes —
+    fsdp composes ZeRO-3 style (layer params stay sharded at rest,
+    gathered one layer at a time inside the stage, grads reduce-scattered
+    by the gather's vjp) and the model axis stays GSPMD-auto (stage
+    interiors keep heads/dff sharding through the engine's internal
+    vjps). MoE's load-balance aux rides the engine's manual backward
+    (``pipeline_train_1f1b(with_aux=True)``: each stage vjp gets the aux
+    objective's constant cotangent seed) and the seq2seq encoder half's
+    aux seeds its GPipe vjp directly. Seq2seq runs a HYBRID: the decoder
+    stack (the 3-sublayer half that dominates memory) runs the 1F1B
+    engine with the encoder output as a gradient stream, while the
+    encoder stack runs the GPipe forward with its autodiff backward (its
+    activation stash stays O(microbatches); the decoder's is O(stages)).
+    GPipe keeps chunked loss; that raises here with a pointer back to
     pp_schedule=gpipe.
     """
     import jax.numpy as jnp
@@ -201,10 +205,13 @@ def make_1f1b_train_step(
     from transformer_tpu.train.loss import masked_cross_entropy
     from transformer_tpu.train.trainer import _shift_targets
 
-    if model_cfg.moe_experts:
+    if model_cfg.moe_experts and model_cfg.moe_every > 1:
+        # Same homogeneity rule _raw_sharded_steps enforces for any pipe>1
+        # mesh, repeated here so direct callers get the message too.
         raise ValueError(
-            "pp_schedule='1f1b' does not carry the MoE aux loss through its "
-            "manual backward; use pp_schedule='gpipe'"
+            "pipe>1 requires a homogeneous layer stack: set moe_every=1 "
+            "(every layer MoE) — mixed dense/MoE stacks cannot stack over "
+            "the pipe axis"
         )
     if train_cfg.loss_chunks > 1:
         raise ValueError(
@@ -238,6 +245,7 @@ def make_1f1b_train_step(
     num_mb = train_cfg.pp_microbatches or mesh.shape["pipe"]
 
     seq2seq = not model_cfg.decoder_only
+    moe = bool(model_cfg.moe_experts)
     # Tensor parallelism composes by exclusion, like GPipe: the model axis
     # stays GSPMD-auto so stage interiors keep their heads/dff sharding
     # through the engine's internal vjps.
@@ -250,14 +258,14 @@ def make_1f1b_train_step(
             out = decoder_layer_apply(
                 lp, h, enc_mb, smask, cmask, model_cfg, r, r is None
             )
-            return out[0]
+            return (out[0], out[4]) if moe else out[0]
     else:
         def layer_fn(lp, h, r, ti_mb, to_mb):
             smask = make_padding_mask(ti_mb, PAD_ID)
             out = decoder_layer_apply(
                 lp, h, None, smask, None, model_cfg, r, r is None
             )
-            return out[0]
+            return (out[0], out[4]) if moe else out[0]
 
     if model_cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
@@ -305,20 +313,23 @@ def make_1f1b_train_step(
         params = state.params
 
         enc_vjp = None
+        enc_aux = None
         if seq2seq:
             # Encoder half: GPipe forward with jax.vjp providing its
             # autodiff backward (stash O(microbatches) for this half; the
             # decoder half below gets the O(stages) 1f1b stash). The vjp is
-            # seeded later with the decoder engine's d(enc_out) stream.
+            # seeded later with the decoder engine's d(enc_out) stream —
+            # plus, for MoE, the aux objective's constant seed.
             def enc_forward(p):
                 x = embed_prologue(
                     p["encoder"]["embedding"], src, model_cfg, r_embed_e, False
                 )
 
                 def enc_layer(lp, h, r, emask):
-                    return encoder_layer_apply(
+                    out = encoder_layer_apply(
                         lp, h, emask, model_cfg, r, r is None
-                    )[0]
+                    )
+                    return (out[0], out[2]) if moe else out[0]
 
                 if model_cfg.remat:
                     enc_layer = jax.checkpoint(enc_layer)
@@ -329,16 +340,22 @@ def make_1f1b_train_step(
                     param_specs=_layer_fsdp_specs(
                         p["encoder"]["layers"][0], mesh
                     ),
-                    auto_axes=auto,
+                    with_aux=moe, auto_axes=auto,
                 )
+                aux = None
+                if moe:
+                    out, aux = out
                 if model_cfg.norm_scheme == "pre":
                     out = layernorm_apply(
                         p["encoder"]["final_ln"], out,
                         model_cfg.layernorm_epsilon,
                     )
-                return out
+                return (out, aux) if moe else out
 
-            enc_out, enc_vjp = jax.vjp(enc_forward, params)
+            if moe:
+                (enc_out, enc_aux), enc_vjp = jax.vjp(enc_forward, params)
+            else:
+                enc_out, enc_vjp = jax.vjp(enc_forward, params)
 
         def prologue(p):
             return embed_prologue(
@@ -366,6 +383,7 @@ def make_1f1b_train_step(
             param_specs=_layer_fsdp_specs(params["decoder"]["layers"][0], mesh),
             auto_axes=auto,
             grad_streams=gs,
+            with_aux=moe, aux_weight=model_cfg.moe_aux_weight,
         )
         if seq2seq:
             sums, d_h0, d_stacked, d_nonlayer, (d_enc,) = engine_out
@@ -387,7 +405,16 @@ def make_1f1b_train_step(
             }
         grads = jax.tree.map(jnp.add, d_pro, d_engine)
         if seq2seq:
-            (d_enc_params,) = enc_vjp(d_enc.astype(enc_out.dtype))
+            if moe:
+                # The encoder stack's aux enters the objective with
+                # coefficient moe_aux_weight: seed its cotangent alongside
+                # the activation stream's.
+                (d_enc_params,) = enc_vjp((
+                    d_enc.astype(enc_out.dtype),
+                    jnp.float32(model_cfg.moe_aux_weight),
+                ))
+            else:
+                (d_enc_params,) = enc_vjp(d_enc.astype(enc_out.dtype))
             grads = jax.tree.map(jnp.add, grads, d_enc_params)
         metrics = {
             "loss": sums["loss_sum"] / denom,
@@ -395,6 +422,13 @@ def make_1f1b_train_step(
             "weight": sums["weight"],
             "correct": sums["correct"],
         }
+        if moe:
+            # The engine already normalized its aux to the GPipe forward's
+            # model-level definition; add the encoder half's scalar.
+            metrics["moe_aux"] = (
+                sums["moe_aux"] if enc_aux is None
+                else enc_aux + sums["moe_aux"]
+            )
         updates, new_opt_state = tx.update(grads, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_state = TrainState(

@@ -462,6 +462,8 @@ def pipeline_train_1f1b(
     fsdp_axis: str = "fsdp",
     auto_axes: tuple[str, ...] = (),
     grad_streams: tuple[int, ...] = (),
+    with_aux: bool = False,
+    aux_weight: float = 0.0,
 ) -> tuple[dict, jax.Array, Params, Params] | tuple[
     dict, jax.Array, Params, Params, tuple[jax.Array, ...]
 ]:
@@ -473,6 +475,17 @@ def pipeline_train_1f1b(
     interiors (and the loss head's vocab projection) stay model-axis-sharded
     with XLA-inserted collectives, including through the engine's internal
     ``jax.vjp``s, while the schedule's ppermute/psum ride the manual axes.
+
+    ``with_aux`` carries a per-layer auxiliary loss (MoE load balancing)
+    through the manual backward: the ``layer_fn`` contract becomes
+    ``-> (h, aux_scalar)`` (matching ``pipeline_apply(with_aux=True)``),
+    the objective gains ``aux_weight * aux_model`` where ``aux_model`` is
+    the per-layer auxes summed over layers, averaged over microbatches and
+    batch shards (exactly ``pipeline_apply``'s aux — the gradient seed for
+    each layer call is therefore ``aux_weight / (M * n_batch_shards)``,
+    applied through each stage vjp's second cotangent), and ``sums`` gains
+    ``"moe_aux"``: ``aux_model`` itself, normalized by the engine so the
+    reported metric and the gradient seed share one divisor.
 
     ``grad_streams`` names indices into ``mb_streams`` whose cotangents the
     engine must also return (appended as a fifth tuple element, each shaped
@@ -549,7 +562,23 @@ def pipeline_train_1f1b(
     T = one_f1b_ticks(M, n_stages)
     S_buf = one_f1b_stash_slots(n_stages)
     layers_per_stage = num_layers // n_stages
-    sums_spec = {"loss_sum": P(), "weight": P(), "correct": P()}
+    # The scan carry accumulates the RAW aux sum ("moe_aux_sum"); the
+    # returned dict carries the normalized "moe_aux" (the engine owns the
+    # divisor so the metric can never drift from the gradient seed below).
+    sum_keys = ("loss_sum", "weight", "correct") + (
+        ("moe_aux_sum",) if with_aux else ()
+    )
+    out_sum_keys = ("loss_sum", "weight", "correct") + (
+        ("moe_aux",) if with_aux else ()
+    )
+    sums_spec = {k: P() for k in out_sum_keys}
+    # d(objective)/d(one layer call's aux): the model-level aux is the mean
+    # over microbatches AND batch shards of per-call sums (pipeline_apply's
+    # definition), entering the objective with coefficient aux_weight.
+    n_batch_shards = 1
+    for a in batch_axes:
+        n_batch_shards *= mesh.shape[a]
+    aux_seed = jnp.float32(aux_weight / (M * n_batch_shards))
     manual = tuple(a for a in mesh.axis_names if a not in auto_axes)
     out_specs = (sums_spec, bspec, params_spec, nonlayer_spec)
     if grad_streams:
@@ -579,6 +608,9 @@ def pipeline_train_1f1b(
         is_first = stage == 0
 
         def stage_fwd(lp, h, mb_idx, streams_mb):
+            """-> (h, aux_sum): aux is this stage's layer auxes summed (a
+            constant 0.0 the compiler drops when with_aux is off)."""
+
             def one_layer(h, xs):
                 local_i, layer_p = xs
                 # ZeRO-3: gather this one layer's fsdp-sharded leaves to
@@ -591,12 +623,16 @@ def pipeline_train_1f1b(
                     r = jax.random.fold_in(
                         jax.random.fold_in(rng, global_layer), mb_idx
                     )
-                return layer_fn(layer_p, h, r, *streams_mb), None
+                out = layer_fn(layer_p, h, r, *streams_mb)
+                if with_aux:
+                    h_out, aux = out
+                    return h_out, jnp.asarray(aux, jnp.float32)
+                return out, jnp.float32(0.0)
 
-            h, _ = jax.lax.scan(
+            h, layer_aux = jax.lax.scan(
                 one_layer, h, (jnp.arange(layers_per_stage), lp)
             )
-            return h
+            return h, jnp.sum(layer_aux)
 
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
         bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
@@ -621,7 +657,9 @@ def pipeline_train_1f1b(
             stash = jax.lax.dynamic_update_index_in_dim(
                 stash, inp, f_c % S_buf, 0
             )
-            out = stage_fwd(local_params, inp, f_c, streams_f)
+            # Forward-half aux is discarded: the backward half recomputes it
+            # (rematerialization) where the valid-tick masking lives.
+            out, _ = stage_fwd(local_params, inp, f_c, streams_f)
             fwd_nxt = (
                 jax.lax.ppermute(out, axis, fwd_perm) if n_stages > 1 else out
             )
@@ -645,7 +683,7 @@ def pipeline_train_1f1b(
                     merged[idx] = val
                 return stage_fwd(lp, h, b_c, tuple(merged))
 
-            h_out_rec, stage_vjp = jax.vjp(
+            (h_out_rec, aux_rec), stage_vjp = jax.vjp(
                 fwd_for_vjp, local_params, x_in, gs_b
             )
             # Loss head on the (recomputed) last-stage output: its vjp both
@@ -656,10 +694,23 @@ def pipeline_train_1f1b(
             )
             d_non_mb, d_head_h = head_vjp(jnp.float32(1.0))
             d_out = jnp.where(is_last, d_head_h.astype(bwd_buf.dtype), bwd_buf)
-            d_lp, d_in, d_gs = stage_vjp(d_out)
+            # Second cotangent: the aux objective term seeds EVERY stage's
+            # backward (garbage-tick contributions die in the masked adds).
+            d_lp, d_in, d_gs = stage_vjp((d_out, aux_seed))
             d_stk = masked_add(d_stk, d_lp, b_valid)
             d_non = masked_add(d_non, d_non_mb, jnp.logical_and(b_valid, is_last))
-            sums = masked_add(sums, head_sums, jnp.logical_and(b_valid, is_last))
+            head_mask = jnp.logical_and(b_valid, is_last)
+            new_sums = {
+                k: sums[k] + jnp.where(head_mask, head_sums[k], 0.0)
+                for k in head_sums
+            }
+            if with_aux:
+                # Aux accumulates at every stage (each owns its layers'
+                # auxes), not just the loss-head stage.
+                new_sums["moe_aux_sum"] = sums["moe_aux_sum"] + jnp.where(
+                    b_valid, aux_rec, 0.0
+                )
+            sums = new_sums
             bwd_nxt = (
                 jax.lax.ppermute(d_in, axis, bwd_perm) if n_stages > 1 else d_in
             )
@@ -675,7 +726,7 @@ def pipeline_train_1f1b(
             jnp.zeros((S_buf, *zero_act.shape), zero_act.dtype),
             jax.tree.map(jnp.zeros_like, local_params),
             jax.tree.map(jnp.zeros_like, nonlayer),
-            {k: jnp.float32(0.0) for k in ("loss_sum", "weight", "correct")},
+            {k: jnp.float32(0.0) for k in sum_keys},
         )
         (_, _, _, d_stk, d_non, sums), (d_in_ticks, d_gs_ticks) = jax.lax.scan(
             tick, init, jnp.arange(T)
@@ -707,6 +758,10 @@ def pipeline_train_1f1b(
 
         reduce_axes = (axis,) + batch_axes
         sums = {k: jax.lax.psum(v, reduce_axes) for k, v in sums.items()}
+        if with_aux:
+            # Raw (stage, layer, microbatch, shard) sum -> pipeline_apply's
+            # model-level definition: mean over microbatches + batch shards.
+            sums["moe_aux"] = sums.pop("moe_aux_sum") / (M * n_batch_shards)
         d_non = jax.tree.map(lambda g: jax.lax.psum(g, reduce_axes), d_non)
         if batch_axes:
             if param_specs is None:
