@@ -39,10 +39,9 @@ def define_evaluate_flags() -> None:
 
 def main(argv) -> None:
     del argv
-    if FLAGS.platform:
-        import jax
+    from transformer_tpu.cli.flags import maybe_force_platform
 
-        jax.config.update("jax_platforms", FLAGS.platform)
+    maybe_force_platform()
 
     from transformer_tpu.cli.translate import load_export
     from transformer_tpu.data.tokenizer import SubwordTokenizer

@@ -47,6 +47,9 @@ def main(argv) -> None:
     import jax
 
     jax.config.update("jax_platforms", FLAGS.platform or "cpu")
+    from transformer_tpu.utils.profiling import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from transformer_tpu.data.tokenizer import SubwordTokenizer
     from transformer_tpu.train import CheckpointManager, create_train_state

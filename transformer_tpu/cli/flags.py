@@ -327,7 +327,14 @@ def flags_to_mesh_config(n_devices: int) -> MeshConfig:
 
 
 def maybe_force_platform() -> None:
+    """``--platform`` override, plus the persistent compilation cache
+    (every CLI process re-pays full XLA compiles otherwise; opt out or
+    relocate via ``$TRANSFORMER_TPU_JAX_CACHE``, see
+    ``utils.enable_compilation_cache``)."""
     if FLAGS.platform:
         import jax
 
         jax.config.update("jax_platforms", FLAGS.platform)
+    from transformer_tpu.utils.profiling import enable_compilation_cache
+
+    enable_compilation_cache()

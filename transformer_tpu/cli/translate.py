@@ -72,10 +72,9 @@ def load_export(export_path: str, kv_cache_int8: bool = False):
 
 def main(argv) -> None:
     del argv
-    if FLAGS.platform:
-        import jax
+    from transformer_tpu.cli.flags import maybe_force_platform
 
-        jax.config.update("jax_platforms", FLAGS.platform)
+    maybe_force_platform()
 
     from transformer_tpu.data.tokenizer import SubwordTokenizer
     from transformer_tpu.train.decode import translate

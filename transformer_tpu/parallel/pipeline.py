@@ -529,7 +529,8 @@ def pipeline_train_1f1b(
         would weight microbatches wrongly under "tokens" normalization).
 
     Returns ``(sums, d_h0, d_stacked, d_nonlayer)``:
-      sums: global fp32 scalars {"loss_sum", "weight", "correct"}.
+      sums: global fp32 scalars {"loss_sum", "weight", "correct"}, plus
+        "moe_aux" (the normalized model-level aux) when ``with_aux``.
       d_h0: cotangent of ``h0`` (batch-sharded like ``h0``) — feed it to the
         prologue's ``jax.vjp`` to finish the chain.
       d_stacked: gradient tree like ``stacked_params`` (stage-sharded).

@@ -38,7 +38,9 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     so enabling this is always safe.
 
     ``cache_dir`` defaults to ``$TRANSFORMER_TPU_JAX_CACHE`` or a /tmp
-    path shared by all of this repo's processes. Returns the directory.
+    path shared by all of this repo's processes; setting the env var to
+    ``off`` (or ``0``) disables caching entirely. Returns the directory
+    ('' when disabled).
     """
     cache_dir = cache_dir or os.environ.get(
         "TRANSFORMER_TPU_JAX_CACHE",
@@ -47,6 +49,8 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
         # silent cache-miss-forever and an arbitrary-executable hazard.
         f"/tmp/transformer_tpu_jax_cache_{os.getuid()}",
     )
+    if cache_dir in ("off", "0"):
+        return ""
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Small compiles are cheaper to redo than to hash + load.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
