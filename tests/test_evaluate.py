@@ -81,6 +81,30 @@ class TestBleuOnPairs:
         with pytest.raises(ValueError, match="line counts"):
             bleu_on_pairs(params, cfg, tok, tok, SENTENCES, SENTENCES[:-1])
 
+    def test_decode_invariant_to_bucket_width(self, overfit_setup):
+        """The early-exit while_loop must leave outputs identical to a much
+        wider decode budget: once every row hit EOS the remaining tail is
+        structurally PAD, whatever max_len the serve bucket picked."""
+        from transformer_tpu.train.decode import greedy_decode
+
+        params, cfg, tok = overfit_setup
+        ids = np.zeros((4, 8), np.int32)
+        for i, s in enumerate(SENTENCES[:4]):
+            e = [tok.bos_id, *tok.encode(s), tok.eos_id][:8]
+            ids[i, : len(e)] = e
+        narrow = np.asarray(
+            greedy_decode(params, jax.numpy.asarray(ids), cfg, 16,
+                          tok.bos_id, tok.eos_id)
+        )
+        wide = np.asarray(
+            greedy_decode(params, jax.numpy.asarray(ids), cfg, 48,
+                          tok.bos_id, tok.eos_id)
+        )
+        assert (wide[:, :16] == narrow).all()
+        for r in range(len(wide)):  # finished rows: tail is pure PAD
+            if (narrow[r] == tok.eos_id).any():
+                assert (wide[r, 16:] == 0).all(), wide[r]
+
 
 class TestBeamSearch:
     """Beam search (capability beyond the reference's greedy-only decode)."""

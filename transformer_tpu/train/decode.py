@@ -7,9 +7,12 @@ defects fixed by design (SURVEY.md §2.3.2/§2.3.9):
   source tokenizer's BOS/EOS for the decoder, ``train.py:100-106``);
 - decode stops early on EOS (commented out in the reference,
   ``train.py:114-116``) — structurally, finished rows keep emitting pad;
-- the loop is a ``lax.scan`` over a fixed-size buffer with per-layer KV
-  caches: one compile, O(S) work per token — not the reference's concat-grow
-  re-encode-everything loop (``train.py:109-118``) that re-traces per step;
+- the loop is an early-exit ``lax.while_loop`` over a fixed-size buffer with
+  per-layer KV caches: one compile, O(S) work per token, and the loop exits
+  the tick after every row has finished (a serve bucket or eval batch pays
+  for its longest actual output, not the bucket width) — not the reference's
+  concat-grow re-encode-everything loop (``train.py:109-118``) that
+  re-traces per step;
 - output is detokenized text, not raw ids (``train.py:118-121``).
 """
 
@@ -25,6 +28,14 @@ from transformer_tpu.models.decoder import init_decoder_caches, precompute_cross
 from transformer_tpu.models.encoder import encoder_apply
 from transformer_tpu.models.transformer import transformer_decode_step
 from transformer_tpu.ops.masks import make_padding_mask
+
+
+def _dummy_rows(ids: jax.Array) -> jax.Array:
+    """(B, S) ids -> (B, 1) True for all-PAD rows: the power-of-two
+    bucketing dummies ``_pad_batch`` appends. They start decoding
+    "finished" so a garbage row can never pin the early-exit while_loops
+    below at the full ``max_len`` budget."""
+    return ~jnp.any(ids != PAD_ID, axis=1, keepdims=True)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_len", "bos_id", "eos_id"))
@@ -48,23 +59,34 @@ def greedy_decode(
     caches = init_decoder_caches(cfg, batch, max_len + 1)
     cross_kvs = precompute_cross_kvs(params["decoder"], enc_out, cfg)
 
-    def step(carry, t):
-        tok, caches, finished = carry
+    # while_loop, not scan: the loop EXITS once every row has emitted EOS,
+    # so a serve bucket or eval batch pays for its longest actual output,
+    # not the bucket width. Untouched tail positions keep their PAD init —
+    # bit-identical to the full-length scan (finished rows write PAD).
+    def cond(carry):
+        t, _, _, finished, _ = carry
+        return jnp.logical_and(t < max_len, ~jnp.all(finished))
+
+    def body(carry):
+        t, tok, caches, finished, tokens = carry
         logits, caches = transformer_decode_step(
             params, tok, enc_out, enc_mask, caches, t, cfg, cross_kvs=cross_kvs
         )
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
         nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
         finished = jnp.logical_or(finished, nxt == eos_id)
-        return (nxt, caches, finished), nxt[:, 0]
+        tokens = jax.lax.dynamic_update_index_in_dim(tokens, nxt[:, 0], t, 1)
+        return (t + 1, nxt, caches, finished, tokens)
 
     init = (
+        jnp.int32(0),
         jnp.full((batch, 1), bos_id, jnp.int32),
         caches,
-        jnp.zeros((batch, 1), jnp.bool_),
+        _dummy_rows(src_ids),
+        jnp.full((batch, max_len), PAD_ID, jnp.int32),
     )
-    _, tokens = jax.lax.scan(step, init, jnp.arange(max_len, dtype=jnp.int32))
-    return tokens.T  # (B, max_len)
+    *_, tokens = jax.lax.while_loop(cond, body, init)
+    return tokens  # (B, max_len)
 
 
 @partial(
@@ -88,9 +110,10 @@ def lm_generate(
     models (the seq2seq entry point is ``greedy_decode``; no reference
     counterpart — the reference is translation-only).
 
-    One compiled program: a single ``lax.scan`` walks prompt + generation
-    positions with per-layer KV caches; during the prompt it feeds the next
-    prompt token (prefill), afterwards the previous sample. ``sample=False``
+    One compiled program: a single early-exit ``lax.while_loop`` walks
+    prompt + generation positions with per-layer KV caches; during the
+    prompt it feeds the next prompt token (prefill), afterwards the
+    previous sample. ``sample=False``
     is greedy argmax; ``sample=True`` draws from softmax(logits/temperature),
     optionally truncated to the ``top_k`` highest-probability tokens and/or
     the nucleus of tokens whose cumulative probability reaches ``top_p``
@@ -128,8 +151,15 @@ def lm_generate(
             logits = jnp.where(logits < thresh, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    def step(carry, t):
-        tok, caches, finished = carry
+    # while_loop with an early exit (like greedy_decode): once every row
+    # has finished generating, remaining ticks are pure PAD — skip them.
+    # Untouched buffer tail stays PAD, so outputs match the full scan.
+    def cond(carry):
+        t, _, _, finished, _ = carry
+        return jnp.logical_and(t < total - 1, ~jnp.all(finished))
+
+    def body(carry):
+        t, tok, caches, finished, toks = carry
         logits, caches = transformer_decode_step(
             params, tok, None, None, caches, t, cfg
         )
@@ -144,19 +174,19 @@ def lm_generate(
             finished, jnp.logical_and(~in_prompt, nxt == eos_id)
         )
         emitted = jnp.where(in_prompt, PAD_ID, nxt[:, :1])
-        return (nxt, caches, finished), emitted[:, 0]
+        toks = jax.lax.dynamic_update_index_in_dim(toks, emitted[:, 0], t, 1)
+        return (t + 1, nxt, caches, finished, toks)
 
     init = (
+        jnp.int32(0),
         prompt_ids[:, :1],
         caches,
-        jnp.zeros((batch, 1), jnp.bool_),
+        _dummy_rows(prompt_ids),  # bucketing dummies start finished
+        jnp.full((batch, total - 1), PAD_ID, jnp.int32),
     )
-    _, toks = jax.lax.scan(
-        step, init, jnp.arange(total - 1, dtype=jnp.int32)
-    )
-    # toks[t] holds the token generated for position t+1; generation starts
-    # at each row's prompt_len. Gather each row's max_new generated tokens.
-    toks = toks.T  # (B, total-1)
+    *_, toks = jax.lax.while_loop(cond, body, init)
+    # toks[:, t] holds the token generated for position t+1; generation
+    # starts at each row's prompt_len. Gather each row's max_new tokens.
     cols = prompt_lens - 1 + jnp.arange(max_new)[None, :]  # (B, max_new)
     cols = jnp.minimum(cols, total - 2)
     return jnp.take_along_axis(toks, cols, axis=1)
@@ -180,8 +210,9 @@ def beam_search_decode(
 
     Capability beyond the reference (greedy only, ``train.py:112``). TPU-shaped
     throughout: static beam width, one compiled program — beams ride the batch
-    dimension (B·K) through the same KV-cached decode step greedy uses, a
-    ``lax.scan`` advances all beams one token per tick, and beam reordering is
+    dimension (B·K) through the same KV-cached decode step greedy uses, an
+    early-exit ``lax.while_loop`` advances all beams one token per tick
+    (exiting once every beam is frozen), and beam reordering is
     a batched gather of cache rows. Finished beams are frozen by forcing PAD
     with probability one. Scores use GNMT length normalization
     ``log p / ((5+len)/6)^alpha`` applied at selection time.
@@ -203,8 +234,15 @@ def beam_search_decode(
         for k, v in precompute_cross_kvs(params["decoder"], enc_out, cfg)
     ]
 
-    def step(carry, t):
-        tok, caches, scores, finished, tokens_buf = carry
+    # while_loop with an early exit (like greedy_decode): once every beam
+    # of every row is frozen, further ticks only append PAD at zero score —
+    # identical selection, so skip them.
+    def cond(carry):
+        t, _, _, _, finished, _ = carry
+        return jnp.logical_and(t < max_len, ~jnp.all(finished))
+
+    def body(carry):
+        t, tok, caches, scores, finished, tokens_buf = carry
         # tok: (B*K, 1); scores/finished: (B, K); tokens_buf: (B, K, max_len)
         logits, caches = transformer_decode_step(
             params, tok, enc_out_k, enc_mask_k, caches, t, cfg,
@@ -243,17 +281,19 @@ def beam_search_decode(
         new_finished = jnp.logical_or(finished, nxt_tok == eos_id)
         emit = jnp.where(finished, PAD_ID, nxt_tok)  # pad after freeze
         tok = emit.reshape(batch * K, 1)
-        return (tok, caches, flat_scores, new_finished, tokens_buf), None
+        return (t + 1, tok, caches, flat_scores, new_finished, tokens_buf)
 
     init = (
+        jnp.int32(0),
         jnp.full((batch * K, 1), bos_id, jnp.int32),
         caches,
         jnp.zeros((batch, K), jnp.float32),
-        jnp.zeros((batch, K), jnp.bool_),
+        # Bucketing dummies start with every beam frozen.
+        jnp.broadcast_to(_dummy_rows(src_ids), (batch, K)),
         jnp.full((batch, K, max_len), PAD_ID, jnp.int32),
     )
-    (tok, caches, scores, finished, tokens_buf), _ = jax.lax.scan(
-        step, init, jnp.arange(max_len, dtype=jnp.int32)
+    _, tok, caches, scores, finished, tokens_buf = jax.lax.while_loop(
+        cond, body, init
     )
     # Length-normalized selection: len = tokens up to and incl. EOS (finished)
     # or max_len (unfinished).
