@@ -1,0 +1,211 @@
+"""Encoder-only masked-LM family (``ModelConfig.encoder_only`` +
+``TrainConfig.objective="mlm"``): masking statistics, learning, eval
+determinism, validation, and the sharded-step composition.
+
+No reference counterpart (the reference is translation-only,
+``README.md:1-5``) — this pins the framework's third model family the way
+test_train pins the causal two.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from transformer_tpu.config import PAD_ID, ModelConfig, TrainConfig
+from transformer_tpu.models import transformer_apply, transformer_init
+from transformer_tpu.train import create_train_state, make_eval_step, make_train_step
+from transformer_tpu.train.mlm import mask_tokens
+
+VOCAB = 41  # 40 real ids + the reserved top id (40) for [MASK]
+CFG = ModelConfig(
+    num_layers=2, d_model=32, num_heads=4, dff=64,
+    input_vocab_size=VOCAB, target_vocab_size=VOCAB,
+    max_position=16, dropout_rate=0.0, dtype="float32",
+    encoder_only=True, tie_output=True,
+)
+TCFG = TrainConfig(
+    batch_size=8, sequence_length=12, warmup_steps=20,
+    lr_schedule="constant", peak_lr=3e-3, objective="mlm",
+    log_every_steps=0, eval_every_steps=0,
+)
+
+
+def _batch():
+    """Each row is one repeated token id (3 + row): masked positions are
+    trivially predictable from the unmasked context, so learning is fast
+    and failures point at the objective plumbing, not model capacity."""
+    tok = np.arange(3, 11, dtype=np.int32)[:, None]
+    x = np.broadcast_to(tok, (8, 12)).copy()
+    x[:, -2:] = PAD_ID  # a pad tail, so the PAD-exclusion paths execute
+    return x
+
+
+class TestMasking:
+    def test_stats_and_determinism(self):
+        rng = jax.random.PRNGKey(0)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(1, VOCAB - 1, (64, 128)),
+            jnp.int32,
+        )
+        masked, labels = mask_tokens(tokens, rng, VOCAB, mask_rate=0.15)
+        masked2, labels2 = mask_tokens(tokens, rng, VOCAB, mask_rate=0.15)
+        np.testing.assert_array_equal(masked, masked2)  # same rng, same mask
+        np.testing.assert_array_equal(labels, labels2)
+        sel = np.asarray(labels != PAD_ID)
+        frac = sel.mean()
+        assert 0.12 < frac < 0.18, frac  # ~15% of positions selected
+        # Selected positions: labels carry the ORIGINAL token.
+        np.testing.assert_array_equal(
+            np.asarray(labels)[sel], np.asarray(tokens)[sel]
+        )
+        # Unselected positions pass through unchanged.
+        np.testing.assert_array_equal(
+            np.asarray(masked)[~sel], np.asarray(tokens)[~sel]
+        )
+        m = np.asarray(masked)[sel]
+        orig = np.asarray(tokens)[sel]
+        frac_mask = (m == VOCAB - 1).mean()
+        frac_keep = (m == orig).mean()
+        assert 0.72 < frac_mask < 0.88, frac_mask  # ~80% [MASK]
+        assert 0.05 < frac_keep < 0.16, frac_keep  # ~10% kept
+        assert (m != PAD_ID).all()  # random draws never produce PAD
+
+    def test_pad_positions_never_selected(self):
+        tokens = jnp.asarray(_batch())
+        masked, labels = mask_tokens(tokens, jax.random.PRNGKey(1), VOCAB)
+        pad = np.asarray(tokens) == PAD_ID
+        np.testing.assert_array_equal(np.asarray(labels)[pad], PAD_ID)
+        np.testing.assert_array_equal(np.asarray(masked)[pad], PAD_ID)
+
+
+class TestEncoderOnlyModel:
+    def test_init_and_forward_shapes(self):
+        params = transformer_init(jax.random.PRNGKey(0), CFG)
+        assert set(params) == {"encoder"}  # no decoder tower, tied head
+        logits, _ = transformer_apply(params, None, jnp.asarray(_batch()), CFG)
+        assert logits.shape == (8, 12, VOCAB)
+
+    def test_untied_head(self):
+        cfg = dataclasses.replace(CFG, tie_output=False)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        assert set(params) == {"encoder", "final"}
+        logits, _ = transformer_apply(params, None, jnp.asarray(_batch()), cfg)
+        assert logits.shape == (8, 12, VOCAB)
+
+    def test_both_towers_rejected(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            dataclasses.replace(CFG, decoder_only=True)
+
+    def test_no_decode_path(self):
+        from transformer_tpu.train.decode import translate
+
+        params = transformer_init(jax.random.PRNGKey(0), CFG)
+
+        class _Tok:
+            bos_id, eos_id = 1, 2
+
+            def encode(self, s):
+                return [3]
+
+        with pytest.raises(ValueError, match="no autoregressive decode"):
+            translate(params, CFG, _Tok(), _Tok(), "x")
+
+
+class TestMlmTraining:
+    def test_learns_and_eval_deterministic(self):
+        state = create_train_state(jax.random.PRNGKey(0), CFG, TCFG)
+        step = jax.jit(make_train_step(CFG, TCFG))
+        x = jnp.asarray(_batch())
+        rng = jax.random.PRNGKey(7)
+        first = None
+        for _ in range(150):
+            state, m = step(state, x, x, rng)
+            if first is None:
+                first = float(m["loss"])
+        last = float(m["loss"])
+        assert last < first / 4, (first, last)
+        acc = float(m["correct"]) / max(float(m["weight"]), 1.0)
+        assert acc > 0.9, acc  # masked repeated-token prediction is easy
+
+        ev = jax.jit(make_eval_step(CFG, TCFG))
+        e1, e2 = ev(state, x, x), ev(state, x, x)
+        assert float(e1["loss"]) == float(e2["loss"])  # constant eval masks
+        assert float(e1["weight"]) > 0  # some positions were scored
+
+        # Fill-mask round trip: mask one position, the trained model must
+        # recover the original token (row token = 3 + row index).
+        probe = jnp.asarray(_batch()).at[0, 4].set(VOCAB - 1)
+        logits, _ = transformer_apply(state.params, None, probe, CFG)
+        assert int(jnp.argmax(logits[0, 4])) == 3
+
+    def test_objective_family_cross_validation(self):
+        causal_cfg = dataclasses.replace(CFG, encoder_only=False)
+        with pytest.raises(ValueError, match="go together"):
+            make_train_step(causal_cfg, TCFG)
+        with pytest.raises(ValueError, match="go together"):
+            make_train_step(CFG, dataclasses.replace(TCFG, objective="causal"))
+        with pytest.raises(ValueError, match="go together"):
+            make_eval_step(causal_cfg, TCFG)
+
+    def test_grad_accum_matches_plain(self):
+        """MLM + gradient accumulation: same masks (same step rng), so the
+        accumulated update must equal the whole-batch one."""
+        sgd = optax.sgd(1.0)
+        x = jnp.asarray(_batch())
+        rng = jax.random.PRNGKey(3)
+        state = create_train_state(jax.random.PRNGKey(0), CFG, TCFG)
+        s1, m1 = jax.jit(make_train_step(CFG, TCFG, tx=sgd))(state, x, x, rng)
+        accum_cfg = dataclasses.replace(TCFG, grad_accum_steps=2)
+        s2, m2 = jax.jit(make_train_step(CFG, accum_cfg, tx=sgd))(
+            state, x, x, rng
+        )
+        np.testing.assert_allclose(
+            float(m2["loss"]), float(m1["loss"]), rtol=1e-5
+        )
+        for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-4
+            )
+
+
+@pytest.mark.slow
+class TestMlmSharded:
+    def test_dp2_matches_single_device(self):
+        """objective='mlm' through make_sharded_steps on a data=2 mesh:
+        same per-step masks (replicated rng), so loss must match the
+        single-device step."""
+        from transformer_tpu.config import MeshConfig
+        from transformer_tpu.parallel import (
+            create_sharded_state, make_mesh, make_sharded_steps, put_batch,
+        )
+
+        x = _batch()
+        rng = jax.random.PRNGKey(5)
+        state = create_train_state(jax.random.PRNGKey(0), CFG, TCFG)
+        _, m_ref = jax.jit(make_train_step(CFG, TCFG))(
+            state, jnp.asarray(x), jnp.asarray(x), rng
+        )
+        mesh = make_mesh(MeshConfig(data=2), devices=jax.devices()[:2])
+        sstate, sh = create_sharded_state(jax.random.PRNGKey(0), CFG, TCFG, mesh)
+        step, _ = make_sharded_steps(mesh, CFG, TCFG, sh, donate=False)
+        _, m_sh = step(sstate, put_batch(x, mesh), put_batch(x, mesh), rng)
+        np.testing.assert_allclose(
+            float(m_sh["loss"]), float(m_ref["loss"]), rtol=1e-5
+        )
+
+    def test_pipe_mesh_rejected(self):
+        from transformer_tpu.config import MeshConfig
+        from transformer_tpu.parallel import make_mesh
+        from transformer_tpu.parallel.distributed import make_sharded_steps
+        from transformer_tpu.parallel import create_sharded_state
+
+        mesh = make_mesh(
+            MeshConfig(data=1, pipe=2), devices=jax.devices()[:2]
+        )
+        _, sh = create_sharded_state(jax.random.PRNGKey(0), CFG, TCFG, mesh)
+        with pytest.raises(ValueError, match="encoder_only"):
+            make_sharded_steps(mesh, CFG, TCFG, sh)

@@ -58,12 +58,15 @@ def main(argv) -> None:
     buckets = tuple(
         int(x) for x in FLAGS.length_buckets.split(",") if x.strip()
     )
-    if FLAGS.decoder_only:
+    # Same LM-window predicate as cli.train: shared data path and
+    # perplexity (not translate/BLEU) epilogue.
+    lm_mode = FLAGS.decoder_only or FLAGS.objective == "mlm"
+    if lm_mode:
         if buckets:
             raise app.UsageError(
                 "--length_buckets applies to the seq2seq pipeline only; LM "
                 "windows are already fixed-width (drop the flag with "
-                "--decoder_only)"
+                "--decoder_only / --objective=mlm)"
             )
         from transformer_tpu.data.pipeline import load_lm_splits
 
@@ -139,7 +142,21 @@ def main(argv) -> None:
         host_params = trainer.state.params
 
     if jax.process_index() == 0:
-        if not FLAGS.decoder_only:
+        if lm_mode:
+            # LM quality metric: perplexity from fit()'s final-epoch full
+            # eval (MLM: pseudo-perplexity over the deterministically-masked
+            # eval positions) — the same epilogue cli.train prints.
+            if test_ds is not None and trainer.eval_metrics.weight > 0:
+                import math
+
+                logging.info(
+                    "eval loss %.4f, perplexity %.2f",
+                    trainer.eval_metrics.loss,
+                    math.exp(min(trainer.eval_metrics.loss, 30.0)),
+                )
+            elif test_ds is not None:
+                logging.warning("eval split produced no tokens; no perplexity")
+        else:
             sample = ["he goes to school"]
             out = translate(
                 host_params, model_cfg, src_tok, tgt_tok, sample,
@@ -151,7 +168,7 @@ def main(argv) -> None:
 
         # End-of-run BLEU on the test split (same epilogue as cli.train so
         # both entry points report the north-star metric).
-        if FLAGS.eval_bleu and not FLAGS.decoder_only:
+        if FLAGS.eval_bleu and not lm_mode:
             from transformer_tpu.train.evaluate import bleu_on_test_files
 
             bleu_on_test_files(

@@ -129,6 +129,16 @@ def define_flags() -> None:
         "decoder-only model on the target-side corpus chunked into "
         "sequence_length windows (BASELINE configs[4]); translation-side "
         "flags are ignored")
+    flags.DEFINE_enum(
+        "objective", "causal", ["causal", "mlm"],
+        "training objective: 'causal' (teacher-forcing seq2seq / LM) or "
+        "'mlm' (BERT-style masked-LM on an encoder-only model: trains on "
+        "target-side LM windows like --decoder_only, masks dynamically "
+        "in-step, reserves the top input id for [MASK])")
+    flags.DEFINE_float(
+        "mlm_mask_rate", 0.15,
+        "fraction of non-pad positions selected per MLM step (80/10/10 "
+        "mask/random/keep split within the selection)")
     flags.DEFINE_enum("attention_impl", "xla", ["xla", "flash", "ring", "ulysses"],
                       "attention kernel (ring/ulysses = sequence-parallel, use with --sp>1)")
     flags.DEFINE_string("dtype", "bfloat16", "compute dtype")
@@ -245,6 +255,12 @@ def define_flags() -> None:
 
 def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> ModelConfig:
     apply_preset()
+    if FLAGS.objective == "mlm":
+        # Reserve the top input id for [MASK] (train/mlm.py): the model
+        # vocab is one larger than the tokenizer's; head and embedding
+        # share the single (extended) id space.
+        input_vocab_size += 1
+        target_vocab_size = input_vocab_size
     return ModelConfig(
         num_layers=FLAGS.num_layers,
         d_model=FLAGS.d_model,
@@ -258,6 +274,7 @@ def flags_to_model_config(input_vocab_size: int, target_vocab_size: int) -> Mode
         norm_scheme=FLAGS.norm_scheme,
         position_scheme=FLAGS.position_scheme,
         decoder_only=FLAGS.decoder_only,
+        encoder_only=FLAGS.objective == "mlm",
         tie_embeddings=FLAGS.tie_embeddings,
         tie_output=FLAGS.tie_output,
         ffn_activation=FLAGS.ffn_activation,
@@ -301,6 +318,8 @@ def flags_to_train_config() -> TrainConfig:
         grad_accum_steps=FLAGS.grad_accum,
         loss_chunks=FLAGS.loss_chunks,
         steps_per_dispatch=FLAGS.steps_per_dispatch,
+        objective=FLAGS.objective,
+        mlm_mask_rate=FLAGS.mlm_mask_rate,
     )
 
 

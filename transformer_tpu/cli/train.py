@@ -50,15 +50,18 @@ def main(argv) -> None:
     buckets = tuple(
         int(x) for x in FLAGS.length_buckets.split(",") if x.strip()
     )
-    if FLAGS.decoder_only:
+    # LM-window mode: decoder-only causal LM and encoder-only masked LM
+    # share the data path and the perplexity (not translate/BLEU) epilogue.
+    lm_mode = FLAGS.decoder_only or FLAGS.objective == "mlm"
+    if lm_mode:
         if buckets:
             raise app.UsageError(
                 "--length_buckets applies to the seq2seq pipeline only; LM "
                 "windows are already fixed-width (drop the flag with "
-                "--decoder_only)"
+                "--decoder_only / --objective=mlm)"
             )
-        # Causal-LM mode: the target-side corpus as one chunked token stream
-        # (the data path behind the long-context decoder-only config).
+        # LM-window mode (causal decoder-only AND masked-LM encoder-only):
+        # the target-side corpus as one chunked token stream.
         from transformer_tpu.data.pipeline import load_lm_splits
 
         train_ds, test_ds, tok = load_lm_splits(
@@ -121,8 +124,10 @@ def main(argv) -> None:
     )
     trainer.fit(train_ds, test_ds)
 
-    if FLAGS.decoder_only:
+    if lm_mode:
         # LM quality metric: perplexity from fit()'s final-epoch full eval
+        # (for MLM: pseudo-perplexity over the deterministically-masked
+        # eval positions)
         # (trainer.evaluate already ran over the whole split; re-running it
         # here would double end-of-run eval time for the same number).
         if test_ds is not None and trainer.eval_metrics.weight > 0:
@@ -148,7 +153,7 @@ def main(argv) -> None:
     # End-of-run quality metric (BASELINE.json north star): corpus BLEU on
     # the test split, when one exists. The reference never computes any
     # translation-quality metric (token accuracy only, train.py:140-141).
-    if FLAGS.eval_bleu and not FLAGS.decoder_only:
+    if FLAGS.eval_bleu and not lm_mode:
         from transformer_tpu.train.evaluate import bleu_on_test_files
 
         bleu_on_test_files(

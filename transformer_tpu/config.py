@@ -59,6 +59,12 @@ class ModelConfig:
     tie_output: bool = False  # logits = h @ embedding.T instead of a fresh Dense
     # BASELINE.json configs[4]: decoder-only causal LM (no encoder, no cross-attn).
     decoder_only: bool = False
+    # Encoder-only bidirectional model (BERT family): the encoder stack with
+    # padding masks only, plus the vocab head — trained with the masked-LM
+    # objective (TrainConfig.objective="mlm"). No reference counterpart (the
+    # reference is translation-only); completes the encoder / decoder /
+    # encoder-decoder family triad.
+    encoder_only: bool = False
     # Activation in the pointwise FFN; reference uses relu (``point_ffn.py:5``).
     # swiglu/geglu/reglu are the gated three-matmul variants (Shazeer 2020) —
     # the modern-LLM FFN (dense layers only; MoE experts stay ungated).
@@ -116,6 +122,10 @@ class ModelConfig:
             raise ValueError(
                 f"d_model ({self.d_model}) must be divisible by num_heads "
                 f"({self.num_heads})"
+            )
+        if self.encoder_only and self.decoder_only:
+            raise ValueError(
+                "encoder_only and decoder_only are mutually exclusive"
             )
         if self.norm_scheme not in ("post", "pre"):
             raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
@@ -266,11 +276,27 @@ class TrainConfig:
     # update). Trade-off: preemption/log/eval granularity becomes K steps.
     # 1 = off.
     steps_per_dispatch: int = 1
+    # Training objective: "causal" (teacher-forcing shift — seq2seq and
+    # decoder-only LM) or "mlm" (BERT-style dynamic masked-LM for
+    # ModelConfig.encoder_only: 15% of non-pad positions selected per step,
+    # 80% [MASK] / 10% random / 10% kept; loss only on selected positions).
+    # The [MASK] id is the model's top input id (input_vocab_size - 1) —
+    # size the vocab one larger than the tokenizer's (train/mlm.py).
+    objective: str = "causal"
+    mlm_mask_rate: float = 0.15
 
     def __post_init__(self) -> None:
         if self.loss_normalization not in ("tokens", "batch"):
             raise ValueError(
                 f"loss_normalization must be 'tokens' or 'batch', got {self.loss_normalization!r}"
+            )
+        if self.objective not in ("causal", "mlm"):
+            raise ValueError(
+                f"objective must be 'causal' or 'mlm', got {self.objective!r}"
+            )
+        if not 0.0 < self.mlm_mask_rate < 1.0:
+            raise ValueError(
+                f"mlm_mask_rate must be in (0, 1), got {self.mlm_mask_rate}"
             )
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(

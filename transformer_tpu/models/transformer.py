@@ -9,7 +9,10 @@ every call (``Transformer.py:21-23``). Extensions beyond the reference:
 - ``cfg.tie_output``: logits via the transposed embedding table instead of the
   reference's untied Dense (``Transformer.py:16,30``);
 - ``cfg.decoder_only``: a causal LM with no encoder at all — forward takes the
-  token sequence alone (BASELINE.json configs[4]).
+  token sequence alone (BASELINE.json configs[4]);
+- ``cfg.encoder_only``: a bidirectional encoder with the vocab head (BERT
+  family) — trained with the masked-LM objective
+  (``TrainConfig.objective="mlm"``, ``train/mlm.py``).
 """
 
 from __future__ import annotations
@@ -27,7 +30,9 @@ from transformer_tpu.ops.nn import Params, dense_apply, dense_init, embedding_at
 
 def transformer_init(key: jax.Array, cfg: ModelConfig) -> Params:
     k_enc, k_dec, k_final = jax.random.split(key, 3)
-    if cfg.decoder_only:
+    if cfg.encoder_only:
+        params = {"encoder": encoder_init(k_enc, cfg)}
+    elif cfg.decoder_only:
         params: Params = {"decoder": decoder_init(k_dec, cfg)}
     else:
         encoder = encoder_init(k_enc, cfg)
@@ -49,7 +54,8 @@ def transformer_init(key: jax.Array, cfg: ModelConfig) -> Params:
 
 def _logits(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     if cfg.tie_output:
-        return embedding_attend(params["decoder"]["embedding"], x)
+        tower = "encoder" if cfg.encoder_only else "decoder"
+        return embedding_attend(params[tower]["embedding"], x)
     return dense_apply(params["final"], x)
 
 
@@ -79,6 +85,16 @@ def transformer_hidden_apply(
     score the (huge) vocab logits a sequence slice at a time instead of
     materializing the full (B, S, V) tensor.
     """
+    if cfg.encoder_only:
+        # BERT family: the bidirectional encoder stack, padding mask only
+        # (no causality — every position attends to the full sequence).
+        mask = make_padding_mask(tar, pad_id)
+        x, attn = encoder_apply(
+            params["encoder"], tar, mask, cfg, rng, deterministic,
+            return_weights,
+        )
+        return x, attn
+
     if cfg.decoder_only:
         self_mask = make_padding_mask(tar, pad_id)  # ANDed with causal inside MHA
         x, attn, _ = decoder_apply(

@@ -462,6 +462,16 @@ def _raw_sharded_steps(
             "(every layer MoE) — mixed dense/MoE stacks cannot stack over "
             "the pipe axis"
         )
+    if model_cfg.encoder_only and (
+        mesh.shape.get("pipe", 1) > 1 or mesh.shape.get("seq", 1) > 1
+    ):
+        # The pipelined/sequence-parallel forward builders are written for
+        # the decoder-bearing families; encoder-only (MLM) shards over
+        # data / fsdp / model / expert via plain GSPMD today.
+        raise ValueError(
+            "encoder_only models support data/fsdp/model/expert mesh axes; "
+            "pipe and seq are not wired for the encoder-only forward"
+        )
     ep = mesh.shape.get("expert", 1)
     if ep > 1 and model_cfg.moe_experts % ep:
         # Without this check _divisible would silently replicate every expert

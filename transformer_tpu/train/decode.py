@@ -409,6 +409,11 @@ def translate(
     ``beam_size > 1`` switches from greedy to beam search (GNMT length
     penalty ``alpha``).
     """
+    if cfg.encoder_only:
+        raise ValueError(
+            "encoder_only (MLM) models have no autoregressive decode path; "
+            "score them with transformer_apply / the mlm eval step"
+        )
     if isinstance(sentences, str):
         sentences = [sentences]
     encoded = [

@@ -1,0 +1,56 @@
+"""Masked-LM objective: BERT-style dynamic masking.
+
+No reference counterpart (`/root/reference` is translation-only,
+``README.md:1-5``); this completes the encoder-only family
+(``ModelConfig.encoder_only``) the way ``decoder_only`` completed the
+causal-LM one. Masking happens INSIDE the jitted train step from the step
+rng ("dynamic masking": every epoch sees fresh masks, the RoBERTa
+improvement over static preprocessing) — the data pipeline stays the plain
+LM-window stream, and the host does zero per-step masking work.
+
+The [MASK] token is the model's top input id (``input_vocab_size - 1``):
+callers size the model vocab ONE larger than the tokenizer's
+(``cli.train --objective=mlm`` does this), so no tokenizer change and no
+collision with real subwords.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.config import PAD_ID
+
+
+def mask_tokens(
+    tokens: jax.Array,
+    rng: jax.Array,
+    vocab_size: int,
+    mask_rate: float = 0.15,
+) -> tuple[jax.Array, jax.Array]:
+    """(B, S) token ids -> (masked_input, labels) for one MLM step.
+
+    ``mask_rate`` of the non-PAD positions are selected; of those, 80% are
+    replaced by [MASK] (= ``vocab_size - 1``), 10% by a uniform random real
+    token, 10% kept unchanged (the canonical 80/10/10). ``labels`` carries
+    the ORIGINAL token at selected positions and PAD everywhere else, so
+    ``masked_cross_entropy`` scores exactly the selected positions (its
+    weight mask is ``labels != PAD_ID``).
+    """
+    mask_id = vocab_size - 1
+    r_sel, r_kind, r_rand = jax.random.split(rng, 3)
+    real = tokens != PAD_ID
+    sel = jnp.logical_and(
+        jax.random.uniform(r_sel, tokens.shape) < mask_rate, real
+    )
+    kind = jax.random.uniform(r_kind, tokens.shape)
+    # Random replacements draw from [1, mask_id): real ids only — never PAD
+    # (id 0 is structurally padding) and never [MASK] itself.
+    rand_tok = jax.random.randint(r_rand, tokens.shape, 1, mask_id)
+    masked = jnp.where(
+        jnp.logical_and(sel, kind < 0.8),
+        jnp.full_like(tokens, mask_id),
+        jnp.where(jnp.logical_and(sel, kind < 0.9), rand_tok, tokens),
+    )
+    labels = jnp.where(sel, tokens, jnp.full_like(tokens, PAD_ID))
+    return masked, labels

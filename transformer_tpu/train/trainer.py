@@ -41,6 +41,43 @@ def _shift_targets(tgt: jax.Array) -> tuple[jax.Array, jax.Array]:
     return tgt[:, :-1], tgt[:, 1:]
 
 
+def _check_objective(model_cfg: ModelConfig, train_cfg: TrainConfig) -> None:
+    if (train_cfg.objective == "mlm") != model_cfg.encoder_only:
+        raise ValueError(
+            "objective='mlm' and ModelConfig.encoder_only go together "
+            "(the masked-LM loss needs the bidirectional encoder stack, and "
+            "an encoder-only model has no causal shift to train on): got "
+            f"objective={train_cfg.objective!r}, "
+            f"encoder_only={model_cfg.encoder_only}"
+        )
+
+
+def _prepare_batch(
+    model_cfg: ModelConfig, train_cfg: TrainConfig, tgt, step_rng
+):
+    """-> (model_input, labels, fwd_rng) for one step.
+
+    causal: the teacher-forcing shift (``_shift_targets``). mlm: BERT-style
+    dynamic masking from the step rng — fresh masks every step
+    (``train/mlm.py``); eval passes ``step_rng=None`` and gets a CONSTANT
+    mask key, so eval losses are deterministic and comparable across
+    epochs/runs (the same positions are always scored).
+    """
+    if train_cfg.objective == "mlm":
+        from transformer_tpu.train.mlm import mask_tokens
+
+        if step_rng is None:
+            r_mask, fwd_rng = jax.random.PRNGKey(train_cfg.seed), None
+        else:
+            r_mask, fwd_rng = jax.random.split(step_rng)
+        inp, labels = mask_tokens(
+            tgt, r_mask, model_cfg.input_vocab_size, train_cfg.mlm_mask_rate
+        )
+        return inp, labels, fwd_rng
+    tar_inp, tar_out = _shift_targets(tgt)
+    return tar_inp, tar_out, step_rng
+
+
 def make_train_step(
     model_cfg: ModelConfig,
     train_cfg: TrainConfig,
@@ -66,6 +103,7 @@ def make_train_step(
     exactly where the (B, S, V) logits OOM.
     """
     tx = tx or make_optimizer(model_cfg, train_cfg)
+    _check_objective(model_cfg, train_cfg)
     chunked = train_cfg.loss_chunks > 1
     if chunked:
         if forward_fn is not None and hidden_forward_fn is None:
@@ -88,16 +126,18 @@ def make_train_step(
         return new_state, metrics
 
     def train_step(state: TrainState, src, tgt, rng):
-        tar_inp, tar_out = _shift_targets(tgt)
         step_rng = jax.random.fold_in(rng, state.step)
+        tar_inp, tar_out, fwd_rng = _prepare_batch(
+            model_cfg, train_cfg, tgt, step_rng
+        )
 
         def loss_fn(params):
             if chunked:
-                x, aux = hidden_forward(params, src, tar_inp, step_rng, False)
+                x, aux = hidden_forward(params, src, tar_inp, fwd_rng, False)
                 loss, metrics = _chunked_loss(params, x, tar_out, model_cfg, train_cfg)
             else:
                 logits, aux = _split_forward_out(
-                    forward_fn(params, src, tar_inp, step_rng, False)
+                    forward_fn(params, src, tar_inp, fwd_rng, False)
                 )
                 loss, metrics = masked_cross_entropy(
                     logits, tar_out,
@@ -129,8 +169,10 @@ def make_train_step(
         so the update equals the whole-batch gradient exactly (for "tokens"
         normalization the denominator is the global non-pad token count —
         chunk-mean averaging would weight chunks unequally)."""
-        tar_inp, tar_out = _shift_targets(tgt)
         step_rng = jax.random.fold_in(rng, state.step)
+        tar_inp, tar_out, step_rng = _prepare_batch(
+            model_cfg, train_cfg, tgt, step_rng
+        )
         batch = src.shape[0]
         if batch % accum:
             raise ValueError(
@@ -335,6 +377,7 @@ def make_eval_step(
     hidden_forward_fn: Callable | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
     """Forward-only eval step (reference ``test_step``, ``train.py:144-157``)."""
+    _check_objective(model_cfg, train_cfg)
     chunked = train_cfg.loss_chunks > 1
     if chunked and forward_fn is not None and hidden_forward_fn is None:
         # Same contract as make_train_step: silently materializing the full
@@ -351,7 +394,7 @@ def make_eval_step(
         forward_fn = _default_forward(model_cfg)
 
     def eval_step(state: TrainState, src, tgt):
-        tar_inp, tar_out = _shift_targets(tgt)
+        tar_inp, tar_out, _ = _prepare_batch(model_cfg, train_cfg, tgt, None)
         if chunked:
             x, aux = hidden_forward(state.params, src, tar_inp, None, True)
             loss, metrics = _chunked_loss(state.params, x, tar_out, model_cfg, train_cfg)
