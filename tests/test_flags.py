@@ -2,6 +2,7 @@
 flags; explicitly-passed flags always win. Runs in subprocesses because absl
 flags are process-global (a second define_flags() would collide)."""
 
+import dataclasses
 import os
 import subprocess
 import sys
@@ -185,6 +186,68 @@ def test_serve_lines_batches_one_decode_per_group(monkeypatch):
     assert resp[2] == {"translation": "T(not json but raw)"}
     assert "error" in resp[3]
     assert resp[4] == {"translation": "T(c)"}
+
+
+def test_serve_lines_fill_mask(monkeypatch):
+    """Encoder-only exports serve 'fill' requests: raw lines map to fill,
+    same-top_k requests batch into ONE fill_mask() call, and kind
+    mismatches answer with a routing error."""
+    from transformer_tpu.cli import serve as serve_mod
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.train import decode as decode_mod
+
+    calls = []
+
+    def fake_fill(params, cfg, tok, texts, top_k=5, **kw):
+        calls.append((tuple(texts), top_k))
+        return [
+            {"filled": t.replace("[MASK]", "x"), "candidates": [[("x", 0.9)]]}
+            for t in texts
+        ]
+
+    monkeypatch.setattr(decode_mod, "fill_mask", fake_fill)
+    cfg = ModelConfig(
+        num_layers=1, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=32, target_vocab_size=32, max_position=16,
+        encoder_only=True,
+    )
+    resp = serve_mod.serve_lines(
+        [
+            "a [MASK] c",                    # raw line -> fill
+            '{"fill": "d [MASK]", "top_k": 2}',
+            '{"fill": "e [MASK]"}',          # default top_k group with [0]
+            '{"src": "nope"}',               # wrong kind for this export
+        ],
+        None, cfg, None, None,
+    )
+    assert len(calls) == 2  # top_k=5 group (2 reqs) + top_k=2 group
+    grouped = {k: t for t, k in calls}
+    assert grouped[5] == ("a [MASK] c", "e [MASK]")
+    assert grouped[2] == ("d [MASK]",)
+    assert resp[0]["filled"] == "a x c"
+    assert resp[0]["candidates"] == [[["x", 0.9]]]  # JSON-clean lists
+    assert resp[1]["filled"] == "d x"
+    assert resp[2]["filled"] == "e x"
+    assert "serves 'fill'" in resp[3]["error"]
+
+    # top_k out of range answers THAT request with the validation message.
+    resp = serve_mod.serve_lines(
+        ['{"fill": "a [MASK]", "top_k": 0}'], None, cfg, None, None
+    )
+    assert "top_k must be in" in resp[0]["error"]
+
+    # A stray 'fill' key on a seq2seq export must not change routing
+    # (unknown keys never did before the fill kind existed).
+    seq_cfg = dataclasses.replace(cfg, encoder_only=False)
+
+    def fake_translate(params, c, src_tok, tgt_tok, sentences, **kw):
+        return [f"T({s})" for s in sentences]
+
+    monkeypatch.setattr(decode_mod, "translate", fake_translate)
+    resp = serve_mod.serve_lines(
+        ['{"src": "hello", "fill": "stray"}'], None, seq_cfg, None, None
+    )
+    assert resp[0] == {"translation": "T(hello)"}
 
 
 def test_serve_lines_error_isolation(monkeypatch):

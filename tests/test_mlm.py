@@ -172,6 +172,60 @@ class TestMlmTraining:
             )
 
 
+class _CharTok:
+    """a..z -> ids 3..28; decode inverts. Enough tokenizer surface for the
+    fill_mask text API (encode/decode/bos_id/eos_id)."""
+
+    bos_id, eos_id = 1, 2
+
+    def encode(self, s):
+        return [3 + (ord(c) - ord("a")) for c in s if c != " "]
+
+    def decode(self, ids):
+        return "".join(chr(ord("a") + int(i) - 3) for i in ids)
+
+
+class TestFillMask:
+    def test_rejects_non_encoder_and_missing_marker(self):
+        from transformer_tpu.train.decode import fill_mask
+
+        tok = _CharTok()
+        causal = dataclasses.replace(CFG, encoder_only=False)
+        with pytest.raises(ValueError, match="encoder_only"):
+            fill_mask(transformer_init(jax.random.PRNGKey(0), causal),
+                      causal, tok, "a[MASK]b")
+        params = transformer_init(jax.random.PRNGKey(0), CFG)
+        with pytest.raises(ValueError, match="marker"):
+            fill_mask(params, CFG, tok, "no masks here")
+
+    def test_fills_trained_token(self):
+        """Train on repeated-letter rows, then the text API must recover a
+        masked letter from its context — candidates exclude PAD/[MASK]."""
+        from transformer_tpu.train.decode import fill_mask
+
+        tok = _CharTok()
+        state = create_train_state(jax.random.PRNGKey(0), CFG, TCFG)
+        step = jax.jit(make_train_step(CFG, TCFG))
+        x = jnp.asarray(_batch())
+        for _ in range(150):
+            state, _ = step(state, x, x, jax.random.PRNGKey(7))
+        # _batch row tokens are ids 3..10 == letters 'a'..'h'.
+        out = fill_mask(
+            state.params, CFG, tok, ["bbbb[MASK]bbbbb", "cc[MASK]c[MASK]ccc"],
+            top_k=3,
+        )
+        assert out[0]["filled"] == "bbbbbbbbbb"
+        assert len(out[0]["candidates"]) == 1
+        assert out[0]["candidates"][0][0][0] == "b"  # top candidate text
+        assert len(out[1]["candidates"]) == 2
+        assert out[1]["filled"] == "cccccccc"
+        for cands in out[0]["candidates"] + out[1]["candidates"]:
+            assert len(cands) == 3
+            probs = [p for _, p in cands]
+            assert all(0.0 <= p <= 1.0 for p in probs)
+            assert probs == sorted(probs, reverse=True)
+
+
 @pytest.mark.slow
 class TestMlmSharded:
     def test_dp2_matches_single_device(self):

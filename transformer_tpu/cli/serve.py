@@ -8,10 +8,12 @@ Each input line is either a JSON object or a raw sentence:
     {"src": "he goes to school"}            seq2seq translation
     {"src": "...", "beam": 4}               per-request beam override
     {"prompt": "...", "max_new": 32}        decoder-only LM continuation
+    {"fill": "he [MASK] to school"}         encoder-only masked-LM fill
     he goes to school                       raw line == {"src": ...}
+                                            (or prompt/fill per export kind)
 
-One response line per request: {"translation": ...} / {"continuation": ...},
-or {"error": ...} for malformed requests (the loop never dies on one bad
+One response line per request: {"translation": ...} / {"continuation": ...}
+/ {"filled": ..., "candidates": ...}, or {"error": ...} for malformed requests (the loop never dies on one bad
 line). Responses come back in request order.
 
 Two levels of amortization make this the right shape for a long-lived TPU
@@ -50,7 +52,7 @@ def define_serve_flags() -> None:
         "decode signature; 1 = the old request-at-a-time behavior)")
 
 
-def _parse_line(line: str, decoder_only: bool) -> dict:
+def _parse_line(line: str, model_cfg) -> dict:
     """One stdin line -> request dict (raises on malformed input)."""
     if line.startswith("{"):
         req = json.loads(line)
@@ -58,7 +60,9 @@ def _parse_line(line: str, decoder_only: bool) -> dict:
             raise ValueError("request must be a JSON object")
         return req
     # Raw-line convenience maps to whichever request kind this export serves.
-    return {"prompt" if decoder_only else "src": line}
+    if model_cfg.encoder_only:
+        return {"fill": line}
+    return {"prompt" if model_cfg.decoder_only else "src": line}
 
 
 def _signature(
@@ -66,6 +70,17 @@ def _signature(
 ) -> tuple | None:
     """Batching key: requests in the same group run as ONE decode call.
     None = malformed or kind-mismatched (answered individually)."""
+    if model_cfg.encoder_only:
+        if "fill" not in req:
+            return None
+        top_k = int(req.get("top_k", 5))
+        if not 1 <= top_k <= 100:
+            # Raised (not returned) so the caller's except answers THIS
+            # request with the message instead of a routing error.
+            raise ValueError(f"top_k must be in [1, 100], got {top_k}")
+        return ("fill", top_k)
+    # Non-MLM exports ignore a stray 'fill' key (unknown keys never
+    # changed routing before the fill kind existed).
     if "src" in req:
         if model_cfg.decoder_only:
             return None
@@ -94,13 +109,18 @@ def serve_lines(
     """Answer a batch of request lines with one decode per signature group,
     preserving input order. Pure function of its inputs — the unit the
     batching test drives directly."""
-    from transformer_tpu.train.decode import generate, translate
+    from transformer_tpu.train.decode import fill_mask, generate, translate
 
     responses: list[dict | None] = [None] * len(lines)
     groups: dict[tuple, list[tuple[int, dict]]] = {}
+    kind = (
+        "fill-mask" if model_cfg.encoder_only
+        else "LM" if model_cfg.decoder_only else "seq2seq"
+    )
+    served_key = {"fill-mask": "fill", "LM": "prompt", "seq2seq": "src"}[kind]
     for i, line in enumerate(lines):
         try:
-            req = _parse_line(line, model_cfg.decoder_only)
+            req = _parse_line(line, model_cfg)
             # int()/float() on request fields can raise too ("beam": "four"):
             # inside the try so one bad request answers, never kills the loop.
             sig = _signature(req, model_cfg, default_max_len, default_beam)
@@ -108,17 +128,38 @@ def serve_lines(
             responses[i] = {"error": f"{type(e).__name__}: {e}"}
             continue
         if sig is None:
-            if "src" in req:
-                msg = "decoder-only export serves 'prompt', not 'src'"
-            elif "prompt" in req:
-                msg = "seq2seq export serves 'src', not 'prompt'"
+            sent = next(
+                (k for k in ("src", "prompt", "fill") if k in req), None
+            )
+            if sent:
+                msg = f"{kind} export serves '{served_key}', not '{sent}'"
             else:
-                msg = "request needs 'src' (seq2seq) or 'prompt' (LM)"
+                msg = (
+                    "request needs 'src' (seq2seq), 'prompt' (LM) or "
+                    "'fill' (masked-LM)"
+                )
             responses[i] = {"error": msg}
             continue
         groups.setdefault(sig, []).append((i, req))
 
     def run_group(sig, members) -> list[dict]:
+        if sig[0] == "fill":
+            _, top_k = sig
+            outs = fill_mask(
+                params, model_cfg, tgt_tok,
+                [str(req["fill"]) for _, req in members],
+                top_k=top_k,
+            )
+            # Tuples -> lists for clean JSON round-trips.
+            return [
+                {
+                    "filled": o["filled"],
+                    "candidates": [
+                        [[t, p] for t, p in cands] for cands in o["candidates"]
+                    ],
+                }
+                for o in outs
+            ]
         if sig[0] == "src":
             _, max_len, beam = sig
             outs = translate(
@@ -175,7 +216,7 @@ def main(argv) -> None:
     params, model_cfg = load_export(
         FLAGS.export_path, kv_cache_int8=FLAGS.kv_cache_int8
     )
-    if model_cfg.decoder_only:
+    if model_cfg.decoder_only or model_cfg.encoder_only:
         src_tok = tgt_tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
     else:
         src_tok = SubwordTokenizer.load(FLAGS.src_vocab_file)
@@ -187,7 +228,8 @@ def main(argv) -> None:
     logging.info(
         "serving %s from %s; one JSONL request per stdin line, batching up "
         "to %d queued requests per decode",
-        "LM" if model_cfg.decoder_only else "seq2seq",
+        "fill-mask" if model_cfg.encoder_only
+        else "LM" if model_cfg.decoder_only else "seq2seq",
         FLAGS.export_path, max(1, FLAGS.serve_batch),
     )
 

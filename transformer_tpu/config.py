@@ -127,6 +127,15 @@ class ModelConfig:
             raise ValueError(
                 "encoder_only and decoder_only are mutually exclusive"
             )
+        if self.encoder_only and self.input_vocab_size != self.target_vocab_size:
+            # One tower, one id space: the MLM [MASK] id is
+            # input_vocab_size - 1 while the head/loss are sized by
+            # target_vocab_size — a mismatch would silently clamp labels.
+            raise ValueError(
+                "encoder_only models use one id space: input_vocab_size "
+                f"({self.input_vocab_size}) must equal target_vocab_size "
+                f"({self.target_vocab_size})"
+            )
         if self.norm_scheme not in ("post", "pre"):
             raise ValueError(f"norm_scheme must be 'post' or 'pre', got {self.norm_scheme!r}")
         if self.remat_policy not in ("full", "dots"):

@@ -26,7 +26,10 @@ import jax.numpy as jnp
 from transformer_tpu.config import PAD_ID, ModelConfig
 from transformer_tpu.models.decoder import init_decoder_caches, precompute_cross_kvs
 from transformer_tpu.models.encoder import encoder_apply
-from transformer_tpu.models.transformer import transformer_decode_step
+from transformer_tpu.models.transformer import (
+    transformer_apply,
+    transformer_decode_step,
+)
 from transformer_tpu.ops.masks import make_padding_mask
 
 
@@ -451,3 +454,103 @@ def translate(
             )
         )
     return _detokenize_rows(out, n, tgt_tokenizer)
+
+
+def fill_mask(
+    params,
+    cfg: ModelConfig,
+    tokenizer,
+    texts: str | list[str],
+    top_k: int = 5,
+    marker: str = "[MASK]",
+) -> list[dict]:
+    """Masked-token inference for ``cfg.encoder_only`` (MLM) models.
+
+    Each text contains one or more literal ``marker`` occurrences (handled
+    at TEXT level — the marker never reaches the subword tokenizer, which
+    would shred it). Returns one dict per text:
+
+    ``{"filled": <text with every marker replaced by the argmax token>,
+       "candidates": [[(token_text, prob), ...top_k], ...one per marker]}``
+
+    The model's [MASK] id is the reserved top input id
+    (``input_vocab_size - 1``, matching ``train/mlm.py``); PAD, [MASK]
+    itself, and the tokenizer's BOS/EOS (which ``decode`` drops — an EOS
+    "winner" would silently erase the marker from the filled text) are
+    excluded from the candidate distribution. Width buckets to powers of
+    two like ``translate`` so repeat calls share compiles; only the
+    per-position top-k (never the (B, W, V) distribution) leaves the
+    device.
+    """
+    import numpy as np
+
+    if not cfg.encoder_only:
+        raise ValueError(
+            "fill_mask() is for encoder_only (MLM) models; seq2seq/LM "
+            "exports decode with translate()/generate()"
+        )
+    if isinstance(texts, str):
+        texts = [texts]
+    mask_id = cfg.input_vocab_size - 1
+    encoded: list[list[int]] = []
+    for t in texts:
+        parts = t.split(marker)
+        if len(parts) < 2:
+            raise ValueError(f"no {marker!r} marker in {t!r}")
+        ids = [tokenizer.bos_id]
+        for i, part in enumerate(parts):
+            if i:
+                ids.append(mask_id)
+            if part:
+                ids.extend(tokenizer.encode(part))
+        encoded.append(ids)
+    longest = max(len(e) for e in encoded)
+    if longest > cfg.max_position:
+        raise ValueError(
+            f"a text encodes to {longest} tokens but the model's "
+            f"max_position is {cfg.max_position}"
+        )
+    if not 1 <= top_k <= 100:
+        raise ValueError(f"top_k must be in [1, 100], got {top_k}")
+    width = _bucket(longest, cfg.max_position)
+    ids, n = _pad_batch(encoded, width)
+    vals, idx = _fill_mask_topk(
+        params, jnp.asarray(ids), cfg, top_k,
+        (PAD_ID, mask_id, int(tokenizer.bos_id), int(tokenizer.eos_id)),
+    )
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    out = []
+    for row in range(n):
+        row_ids = ids[row].copy()
+        cands = []
+        for pos in np.nonzero(row_ids == mask_id)[0]:
+            cands.append(
+                [
+                    (tokenizer.decode([int(idx[row, pos, k])]).strip(),
+                     float(vals[row, pos, k]))
+                    for k in range(top_k)
+                ]
+            )
+            row_ids[pos] = int(idx[row, pos, 0])
+        toks = [
+            int(t) for t in row_ids
+            if t not in (PAD_ID, tokenizer.bos_id, tokenizer.eos_id)
+        ]
+        out.append({"filled": tokenizer.decode(toks), "candidates": cands})
+    return out
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k", "excluded_ids"))
+def _fill_mask_topk(params, ids, cfg: ModelConfig, top_k, excluded_ids):
+    """One bidirectional forward -> per-position top-k (probs, ids), with
+    ``excluded_ids`` (PAD/[MASK]/BOS/EOS) removed from the distribution.
+    top_k stays small, so (B, W, top_k) is all that crosses to the host —
+    the (B, W, V) tensor this repo elsewhere treats as an OOM hazard
+    (``loss_chunks``) never does."""
+    logits, _ = transformer_apply(params, None, ids, cfg)
+    logits = logits.astype(jnp.float32)
+    excluded = jnp.zeros((logits.shape[-1],), jnp.float32)
+    for i in excluded_ids:
+        excluded = excluded.at[i].set(-jnp.inf)
+    probs = jax.nn.softmax(logits + excluded[None, None, :], axis=-1)
+    return jax.lax.top_k(probs, top_k)
