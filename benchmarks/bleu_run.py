@@ -37,6 +37,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# One shapes table for every consumer (score_ckpt.py imports it): drift
+# between the trainer's architecture and a scorer's would restore cleanly
+# into the wrong model whenever param shapes happen to match (num_heads).
+CONFIG_SHAPES = {
+    "tiny": dict(num_layers=2, d_model=128, num_heads=4, dff=512),
+    "small": dict(num_layers=2, d_model=256, num_heads=8, dff=1024),
+    "medium": dict(num_layers=4, d_model=256, num_heads=8, dff=1024),
+    "base": dict(num_layers=6, d_model=512, num_heads=8, dff=2048),
+}
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -161,12 +171,7 @@ def main() -> None:
             "(test pairs excluded)",
             file=sys.stderr,
         )
-    shapes = {
-        "tiny": dict(num_layers=2, d_model=128, num_heads=4, dff=512),
-        "small": dict(num_layers=2, d_model=256, num_heads=8, dff=1024),
-        "medium": dict(num_layers=4, d_model=256, num_heads=8, dff=1024),
-        "base": dict(num_layers=6, d_model=512, num_heads=8, dff=2048),
-    }[args.config]
+    shapes = CONFIG_SHAPES[args.config]
     model_cfg = ModelConfig(
         **shapes,
         input_vocab_size=src_tok.model_vocab_size,

@@ -1,0 +1,106 @@
+"""Score ANY bleu_run checkpoint (including an in-flight run's latest) on
+the held-out test split, without touching the training process.
+
+    python benchmarks/score_ckpt.py --workdir /tmp/bleu_run_<hash> \
+        --config small [--dtype float32] [--step N] [--beam 4]
+
+Prints one JSON line: {"metric": ..., "bleu": ..., "step": ..., ...}.
+Exists because resumable runs only self-score at their final epoch target
+(``bleu_run.py``): when a relay outage or round boundary lands mid-run, the
+partial convergence is still checkpointed — this recovers a real number
+from it. Reconstructs the model EXACTLY as bleu_run does (same shapes
+table, the run's own workdir vocabs, same specials).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True, help="the bleu_run workdir")
+    ap.add_argument(
+        "--config", default="small",
+        choices=["tiny", "small", "medium", "base"],
+    )
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--step", type=int, default=0, help="0 = latest")
+    ap.add_argument("--beam", type=int, default=1)
+    ap.add_argument("--seq_len", type=int, default=50,
+                    help="the run's --seq_len (sizes the positional table)")
+    ap.add_argument("--holdout", type=int, default=1,
+                    help="the run's --holdout (recorded in the output; a "
+                    "--holdout 0 run's score is IN-sample)")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--bleu_max_len", type=int, default=64)
+    ap.add_argument("--data_dir", default=os.path.join(REPO, "data"))
+    args = ap.parse_args()
+
+    import jax
+
+    from transformer_tpu.config import ModelConfig, TrainConfig
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.train import CheckpointManager, create_train_state
+    from transformer_tpu.train.evaluate import bleu_on_pairs, read_lines
+    from transformer_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    src_tok = SubwordTokenizer.load(os.path.join(args.workdir, "src_vocab.subwords"))
+    tgt_tok = SubwordTokenizer.load(os.path.join(args.workdir, "tgt_vocab.subwords"))
+    from bleu_run import CONFIG_SHAPES  # benchmarks/ sibling: one table
+
+    shapes = CONFIG_SHAPES[args.config]
+    model_cfg = ModelConfig(
+        **shapes,
+        input_vocab_size=src_tok.model_vocab_size,
+        target_vocab_size=tgt_tok.model_vocab_size,
+        max_position=max(args.seq_len, args.bleu_max_len, 64),
+        dropout_rate=0.1,
+        dtype=args.dtype,
+    )
+    state = create_train_state(
+        jax.random.PRNGKey(0), model_cfg,
+        TrainConfig(batch_size=args.batch, sequence_length=args.seq_len, warmup_steps=2000),
+    )
+    ckpt = CheckpointManager(os.path.join(args.workdir, "ckpt"), 2)
+    step = args.step or ckpt.latest_step
+    if not step:
+        raise SystemExit(f"no checkpoints in {args.workdir}/ckpt")
+    state = ckpt.restore(state, step)
+    src_lines = read_lines(os.path.join(args.data_dir, "src-test.txt"))
+    ref_lines = read_lines(os.path.join(args.data_dir, "tgt-test.txt"))
+    t0 = time.perf_counter()
+    bleu, _ = bleu_on_pairs(
+        state.params, model_cfg, src_tok, tgt_tok, src_lines, ref_lines,
+        batch_size=args.batch, max_len=args.bleu_max_len,
+        beam_size=args.beam,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.config} corpus BLEU [ckpt step {step}"
+                + (f", beam{args.beam}" if args.beam > 1 else ", greedy")
+                + "]",
+                "bleu": round(bleu, 2),
+                "n_pairs": len(src_lines),
+                "step": int(step),
+                "holdout": bool(args.holdout),
+                "eval_seconds": round(time.perf_counter() - t0, 1),
+                "device": f"{jax.devices()[0].platform}",
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
