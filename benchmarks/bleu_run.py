@@ -97,6 +97,15 @@ def main() -> None:
         "training (0 = end-of-run only)",
     )
     ap.add_argument(
+        "--stop_patience", type=int, default=0,
+        help="with --bleu_every: stop after this many consecutive probes "
+        "without a new best BLEU, keep the best probe's params as the "
+        "scored model (0 = train the full --epochs budget; best-params "
+        "tracking still runs). The bundled-corpus ladder showed BLEU "
+        "peaking then DROPPING (small+smoothing: 2.34 at epoch 60 -> 2.08 "
+        "at 70), so a fixed budget can overshoot into memorization.",
+    )
+    ap.add_argument(
         "--workdir", default="",
         help="vocab/checkpoint directory; default derives from the run "
         "parameters so different corpora/configs never share stale vocabs "
@@ -128,6 +137,13 @@ def main() -> None:
                 f"missing {path}: the BLEU run needs a test split "
                 "(data/README.md describes the bundled one)"
             )
+    # Persist the run parameters next to the checkpoints: scorers
+    # (benchmarks/score_ckpt.py) read holdout/config from here instead of
+    # trusting their own flags, so an in-sample run can never be mislabeled
+    # "held out" in the evidence JSONL by a default argument.
+    os.makedirs(args.workdir, exist_ok=True)
+    with open(os.path.join(args.workdir, "args.json"), "w") as f:
+        json.dump(vars(args), f, indent=1)
 
     import jax
 
@@ -137,15 +153,16 @@ def main() -> None:
         AsyncCheckpointManager,
         Trainer,
         create_train_state,
+        export_params,
+        load_exported_params,
     )
     from transformer_tpu.train.evaluate import bleu_on_pairs, read_lines
+    from transformer_tpu.train.probe_stop import ProbeKeepBest
     from transformer_tpu.utils import enable_compilation_cache
 
     # Each watchdog pass is a fresh process: without a persistent cache it
     # re-pays the ~210 s base-model compile before training a single step.
     enable_compilation_cache()
-
-    os.makedirs(args.workdir, exist_ok=True)
     dev = jax.devices()[0]
     print(f"training on {dev.platform}:{dev.device_kind}", file=sys.stderr)
 
@@ -170,6 +187,15 @@ def main() -> None:
             f"holdout: training on {train_ds.num_examples} pairs "
             "(test pairs excluded)",
             file=sys.stderr,
+        )
+    if len(train_ds) == 0:
+        # batch_size > surviving examples (the length filter drops pairs
+        # longer than --seq_len after tokenization): every epoch would be
+        # zero steps and the run would "finish" untrained.
+        raise SystemExit(
+            f"no full batches: {train_ds.num_examples} examples survive the "
+            f"seq_len={args.seq_len} length filter but batch_size="
+            f"{args.batch} (drop_remainder) needs at least one full batch"
         )
     shapes = CONFIG_SHAPES[args.config]
     model_cfg = ModelConfig(
@@ -196,7 +222,30 @@ def main() -> None:
         if args.epoch_budget
         else args.epochs
     )
-    if done_epochs:
+    # Keep-best / stop accounting is persisted in the workdir, so the
+    # decision survives the per-relay-window invocation pattern: a stop
+    # decided two windows ago still skips training now and goes straight
+    # to scoring the best snapshot.
+    stopper = ProbeKeepBest(
+        os.path.join(args.workdir, "probe_bleu.json"),
+        patience=args.stop_patience,
+    )
+    best_dir = os.path.join(args.workdir, "best")
+    # The rule only acts when THIS invocation enables it: probes need
+    # --bleu_every, stopping needs --stop_patience. A rerun with the rule
+    # disabled (the flags are outside the workdir hash) must train the full
+    # budget, not silently honor a marker from a differently-flagged run.
+    probing = args.bleu_every > 0
+    stopping = probing and args.stop_patience > 0
+    if stopping and stopper.stopped_epoch is not None:
+        print(
+            f"probe-stop marker present (stopped after epoch "
+            f"{stopper.stopped_epoch}, best {stopper.best_value} at epoch "
+            f"{stopper.best_epoch}); skipping training",
+            file=sys.stderr,
+        )
+        target_epochs = done_epochs
+    elif done_epochs:
         print(
             f"resuming: {done_epochs}/{args.epochs} epochs done, training to "
             f"{target_epochs} this invocation",
@@ -230,38 +279,83 @@ def main() -> None:
     if args.bleu_every:
         def callback(epoch, tr):
             if (epoch + 1) % args.bleu_every:
-                return
+                return False
             t = time.perf_counter()
             probe, _ = bleu_on_pairs(
                 tr.state.params, model_cfg, src_tok, tgt_tok,
                 src_lines[:64], ref_lines[:64],
                 batch_size=args.batch, max_len=args.bleu_max_len,
             )
+            # Export BEFORE recording the new best, and atomically (tmp dir
+            # + per-file os.replace): a tunnel death mid-export must never
+            # leave probe_bleu.json claiming best@N while best/ holds the
+            # previous peak's params or a truncated npz. Crash before the
+            # record: this probe is simply re-run next invocation.
+            if stopper.would_be_best(probe):
+                # Snapshot ONLY the params (export format, ~1/3 the size of
+                # a full train-state checkpoint): the rotating keep-2
+                # checkpoint window will have discarded this epoch by the
+                # time a later probe proves it was the peak.
+                tmp_dir = best_dir + ".tmp"
+                export_params(tr.state.params, model_cfg, tmp_dir)
+                os.makedirs(best_dir, exist_ok=True)
+                for name in ("params.npz", "config.json"):
+                    os.replace(
+                        os.path.join(tmp_dir, name),
+                        os.path.join(best_dir, name),
+                    )
+                os.rmdir(tmp_dir)
+            decision = stopper.update(epoch + 1, probe)
             probe_s[0] += time.perf_counter() - t
-            print(f"epoch {epoch + 1}: probe BLEU {probe:.2f}", file=sys.stderr)
+            print(
+                f"epoch {epoch + 1}: probe BLEU {probe:.2f} [{decision}; "
+                f"best {stopper.best_value:.2f} @ {stopper.best_epoch}]",
+                file=sys.stderr,
+            )
+            return decision == "stop"
 
     t0 = time.perf_counter()
-    trainer.fit(train_ds, test_ds, epoch_callback=callback)
+    try:
+        trainer.fit(train_ds, test_ds, epoch_callback=callback)
+    finally:
+        # fit's own epilogue waits on async saves, but only if it is
+        # reached: a raise mid-epoch (tunnel failure) must not lose an
+        # in-flight background checkpoint write on top of it.
+        ckpt.wait()
     train_s = time.perf_counter() - t0 - probe_s[0]
-    if target_epochs < args.epochs:
+    stopped = stopping and stopper.stopped_epoch is not None
+    if not stopped and target_epochs < args.epochs:
         # Budget-limited invocation: report progress (NO "bleu" key — the
         # watchdog keeps re-invoking until the final line lands) and stop.
-        print(
-            json.dumps(
-                {
-                    "metric": f"{args.config} BLEU run progress",
-                    "epochs_done": target_epochs,
-                    "epochs_target": args.epochs,
-                    "train_seconds": round(train_s, 1),
-                    "device": f"{dev.platform}:{dev.device_kind}",
-                }
-            ),
-            flush=True,
-        )
+        progress = {
+            "metric": f"{args.config} BLEU run progress",
+            "epochs_done": target_epochs,
+            "epochs_target": args.epochs,
+            "train_seconds": round(train_s, 1),
+            "device": f"{dev.platform}:{dev.device_kind}",
+        }
+        if stopper.best_epoch is not None:
+            progress["probe_best"] = stopper.best_value
+            progress["probe_best_epoch"] = stopper.best_epoch
+        print(json.dumps(progress), flush=True)
         return
+    # Final scoring: the run either trained its full budget or the probe
+    # rule stopped it. Score the BEST probe's params when a snapshot
+    # exists — the ladder's peak-then-drop curves are exactly the case
+    # where final != best.
+    early_stopped = stopped
+    epochs_trained = (
+        min(stopper.stopped_epoch, args.epochs) if early_stopped
+        else args.epochs
+    )
+    score_params = trainer.state.params
+    scored = "final"
+    if probing and stopper.best_epoch is not None and os.path.isdir(best_dir):
+        score_params = load_exported_params(best_dir, trainer.state.params)
+        scored = f"best@{stopper.best_epoch}"
     t1 = time.perf_counter()
     bleu, hyps = bleu_on_pairs(
-        trainer.state.params, model_cfg, src_tok, tgt_tok,
+        score_params, model_cfg, src_tok, tgt_tok,
         src_lines, ref_lines,
         batch_size=args.batch, max_len=args.bleu_max_len,
         log_fn=lambda msg: print(msg, file=sys.stderr),
@@ -269,28 +363,30 @@ def main() -> None:
     eval_s = time.perf_counter() - t1
     for src, hyp, ref in list(zip(src_lines, hyps, ref_lines))[:3]:
         print(f"SRC {src}\nHYP {hyp}\nREF {ref}\n", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"{args.config} corpus BLEU (bundled test split, greedy, "
-                    + ("held out" if args.holdout else "in-sample")
-                    + ")"
-                ),
-                "bleu": round(bleu, 2),
-                "n_pairs": len(src_lines),
-                "epochs": args.epochs,
-                "vocab": args.vocab,
-                "dtype": args.dtype,
-                "label_smoothing": args.label_smoothing,
-                "holdout": bool(args.holdout),
-                "train_seconds": round(train_s, 1),
-                "eval_seconds": round(eval_s, 1),
-                "device": f"{dev.platform}:{dev.device_kind}",
-            }
+    row = {
+        "metric": (
+            f"{args.config} corpus BLEU (bundled test split, greedy, "
+            + ("held out" if args.holdout else "in-sample")
+            + ")"
         ),
-        flush=True,
-    )
+        "bleu": round(bleu, 2),
+        "n_pairs": len(src_lines),
+        "epochs": epochs_trained,
+        "epochs_budget": args.epochs,
+        "scored": scored,
+        "vocab": args.vocab,
+        "dtype": args.dtype,
+        "label_smoothing": args.label_smoothing,
+        "holdout": bool(args.holdout),
+        "train_seconds": round(train_s, 1),
+        "eval_seconds": round(eval_s, 1),
+        "device": f"{dev.platform}:{dev.device_kind}",
+    }
+    if early_stopped:
+        row["early_stopped"] = True
+        row["probe_best"] = stopper.best_value
+        row["probe_best_epoch"] = stopper.best_epoch
+    print(json.dumps(row), flush=True)
 
     # The greedy headline is committed above; now rescore the SAME model
     # with the two quality levers validated at tiny scale (BASELINE.md):
@@ -319,7 +415,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             print(f"rescore [{tag}] failed: {e!r}", file=sys.stderr)
 
-    _rescore("beam4", trainer.state.params, beam=4)
+    _rescore("beam4", score_params, beam=4)
     steps = ckpt.all_steps()[-2:]
     if len(steps) > 1:
         from transformer_tpu.train.checkpoint import average_checkpoints

@@ -209,9 +209,14 @@ while :; do
     # North star first (two rounds overdue). Incremental: <=8 epochs per
     # pass, resumes from its own checkpoints, emits progress lines until
     # the final {"bleu": ...} line lands.
-    log "running BLEU convergence pass (8-epoch budget, resumable)"
+    log "running BLEU convergence pass (8-epoch budget, resumable, keep-best)"
+    # --bleu_every 4 --stop_patience 2: keep the best-probe params and stop
+    # after two consecutive non-improving probes (the CPU ladder showed BLEU
+    # peaking then dropping — a fixed 40-epoch budget can buy memorization).
+    # The probe cadence is 4 (not 10) so the stop rule can see the peak
+    # within the ~24 epochs that remain after the banked 16.
     timeout 3600 python benchmarks/bleu_run.py --config base --epochs 40 \
-      --bleu_every 10 --epoch_budget 8 --label_smoothing 0.1 \
+      --bleu_every 4 --stop_patience 2 --epoch_budget 8 --label_smoothing 0.1 \
       >>"$BLEU" 2>>bleu_run.err
     rc=$?
     [ "$rc" -ne 0 ] && record_failure "base BLEU run" "$BLEU" "$rc"

@@ -81,6 +81,53 @@ class TestMasking:
         np.testing.assert_array_equal(np.asarray(labels)[pad], PAD_ID)
         np.testing.assert_array_equal(np.asarray(masked)[pad], PAD_ID)
 
+    def test_excluded_ids_never_selected_nor_injected(self):
+        """BOS/EOS exclusion (ADVICE r4): specials are never prediction
+        targets and the 10% random-replacement draw never injects them —
+        while every non-excluded real id can still be drawn (the
+        order-statistics remap skips, not truncates)."""
+        bos, eos = VOCAB - 3, VOCAB - 2  # the framework layout: mask_id-2/-1
+        rng = np.random.default_rng(1)
+        base = rng.integers(1, VOCAB - 1, (64, 128)).astype(np.int32)
+        base[:, 0] = bos  # specials present in every row
+        base[:, 70] = eos
+        tokens = jnp.asarray(base)
+        masked, labels = mask_tokens(
+            tokens, jax.random.PRNGKey(2), VOCAB, excluded_ids=(bos, eos)
+        )
+        labels, masked = np.asarray(labels), np.asarray(masked)
+        special = (base == bos) | (base == eos)
+        np.testing.assert_array_equal(labels[special], PAD_ID)  # not targets
+        np.testing.assert_array_equal(masked[special], base[special])
+        # Replacement draws: positions where masked differs from both the
+        # original and [MASK] are the 10% random draws — none may be a
+        # special, and collectively they should cover other high ids (the
+        # remap shifts past the excluded band rather than clipping it).
+        drawn = masked[(masked != base) & (masked != VOCAB - 1)]
+        assert drawn.size > 0
+        assert not np.isin(drawn, [bos, eos, PAD_ID]).any()
+
+    def test_excluding_whole_vocab_rejected(self):
+        with pytest.raises(ValueError, match="no real tokens"):
+            mask_tokens(
+                jnp.ones((2, 4), jnp.int32), jax.random.PRNGKey(0), 4,
+                excluded_ids=(1, 2),  # vocab 4: mask=3, real ids {1,2}
+            )
+
+    def test_train_step_auto_excludes_bos_eos(self):
+        """The trainer's auto default ((mask_id-2, mask_id-1)) reaches
+        mask_tokens: a batch of ONLY specials+pad yields zero selected
+        positions, so the masked-CE weight (= selected count) is 0."""
+        from transformer_tpu.train.trainer import _prepare_batch
+
+        bos, eos = VOCAB - 3, VOCAB - 2
+        tgt = jnp.asarray(
+            np.array([[bos, eos] * 6] * 8, dtype=np.int32)
+        )
+        inp, labels, _ = _prepare_batch(CFG, TCFG, tgt, jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(np.asarray(labels), PAD_ID)
+        np.testing.assert_array_equal(np.asarray(inp), np.asarray(tgt))
+
 
 class TestEncoderOnlyModel:
     def test_init_and_forward_shapes(self):

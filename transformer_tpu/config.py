@@ -293,6 +293,13 @@ class TrainConfig:
     # size the vocab one larger than the tokenizer's (train/mlm.py).
     objective: str = "causal"
     mlm_mask_rate: float = 0.15
+    # Special ids excluded from MLM selection AND from the 10% random-
+    # replacement draw (BERT/RoBERTa exclude specials from both). None =
+    # auto: the framework's vocab layout puts BOS/EOS at the two ids
+    # directly below [MASK] (tokenizer bos=vocab_size, eos=vocab_size+1,
+    # mask=model_vocab+1-1 — see cli/flags.py MLM sizing), so auto excludes
+    # (mask_id-2, mask_id-1). Pass () to exclude nothing (custom layouts).
+    mlm_excluded_ids: tuple[int, ...] | None = None
 
     def __post_init__(self) -> None:
         if self.loss_normalization not in ("tokens", "batch"):

@@ -614,14 +614,22 @@ def load_exported_params(path: str, template: Any) -> Any:
     new_leaves = []
     for p, leaf in leaves_with_path:
         key = _SEP.join(_path_elem(e) for e in p)
-        dtype = np.asarray(leaf).dtype
+        ref = np.asarray(leaf)
         if key in flat:
-            new_leaves.append(flat[key].astype(dtype))
+            new = flat[key].astype(ref.dtype)
         elif key + _Q8_SUFFIX in flat:
             q = flat[key + _Q8_SUFFIX].astype(np.float32)
-            new_leaves.append(
-                (q * flat[key + _Q8_SCALE_SUFFIX]).astype(dtype)
-            )
+            new = (q * flat[key + _Q8_SCALE_SUFFIX]).astype(ref.dtype)
         else:
             raise KeyError(f"export at {path} has no leaf for {key!r}")
+        if new.shape != ref.shape:
+            # Silent wrong-shape insertion would only blow up (or quietly
+            # mis-score) downstream — e.g. a scorer rebuilding the template
+            # from the wrong --config for this export.
+            raise ValueError(
+                f"export at {path}: leaf {key!r} has shape {new.shape} but "
+                f"the template expects {ref.shape} — was the template built "
+                "from a different model config?"
+            )
+        new_leaves.append(new)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)

@@ -191,7 +191,10 @@ def lm_generate(
     # toks[:, t] holds the token generated for position t+1; generation
     # starts at each row's prompt_len. Gather each row's max_new tokens.
     cols = prompt_lens - 1 + jnp.arange(max_new)[None, :]  # (B, max_new)
-    cols = jnp.minimum(cols, total - 2)
+    # Clamp BOTH ends: all-PAD bucketing dummy rows have prompt_len 0, so
+    # cols would start at -1 and take_along_axis would wrap to the last
+    # buffer column — garbage if a caller ever reads the dummy rows.
+    cols = jnp.clip(cols, 0, total - 2)
     return jnp.take_along_axis(toks, cols, axis=1)
 
 

@@ -27,6 +27,7 @@ def mask_tokens(
     rng: jax.Array,
     vocab_size: int,
     mask_rate: float = 0.15,
+    excluded_ids: tuple[int, ...] = (),
 ) -> tuple[jax.Array, jax.Array]:
     """(B, S) token ids -> (masked_input, labels) for one MLM step.
 
@@ -36,17 +37,39 @@ def mask_tokens(
     the ORIGINAL token at selected positions and PAD everywhere else, so
     ``masked_cross_entropy`` scores exactly the selected positions (its
     weight mask is ``labels != PAD_ID``).
+
+    ``excluded_ids`` (typically the tokenizer's BOS/EOS — BERT/RoBERTa
+    exclude specials from both roles) are never SELECTED as prediction
+    targets and never INJECTED by the 10% random-replacement draw: a
+    mid-sequence EOS from the replacement would teach the encoder a
+    corrupted segmentation signal, not a cloze task.
     """
     mask_id = vocab_size - 1
+    # Static (trace-time) exclusion set: only ids the random draw could
+    # produce matter for the draw remap; selection excludes all of them.
+    excl = tuple(sorted({int(i) for i in excluded_ids if 1 <= i < mask_id}))
+    n_allowed = (mask_id - 1) - len(excl)
+    if n_allowed < 1:
+        raise ValueError(
+            f"excluded_ids {excluded_ids} leave no real tokens to draw "
+            f"random replacements from (vocab_size={vocab_size})"
+        )
     r_sel, r_kind, r_rand = jax.random.split(rng, 3)
     real = tokens != PAD_ID
+    for e in excluded_ids:
+        real = jnp.logical_and(real, tokens != e)
     sel = jnp.logical_and(
         jax.random.uniform(r_sel, tokens.shape) < mask_rate, real
     )
     kind = jax.random.uniform(r_kind, tokens.shape)
-    # Random replacements draw from [1, mask_id): real ids only — never PAD
-    # (id 0 is structurally padding) and never [MASK] itself.
-    rand_tok = jax.random.randint(r_rand, tokens.shape, 1, mask_id)
+    # Random replacements draw uniformly from the ALLOWED real ids — never
+    # PAD (id 0 is structurally padding), never [MASK] itself, never an
+    # excluded special. Draw a rank in the allowed set, then shift past the
+    # excluded ids in ascending order (exact order-statistics remap, no
+    # rejection loop — jit-friendly and still uniform).
+    rand_tok = jax.random.randint(r_rand, tokens.shape, 1, n_allowed + 1)
+    for e in excl:
+        rand_tok = jnp.where(rand_tok >= e, rand_tok + 1, rand_tok)
     masked = jnp.where(
         jnp.logical_and(sel, kind < 0.8),
         jnp.full_like(tokens, mask_id),

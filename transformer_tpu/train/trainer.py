@@ -70,8 +70,15 @@ def _prepare_batch(
             r_mask, fwd_rng = jax.random.PRNGKey(train_cfg.seed), None
         else:
             r_mask, fwd_rng = jax.random.split(step_rng)
+        excluded = train_cfg.mlm_excluded_ids
+        if excluded is None:
+            # Auto: BOS/EOS sit at the two ids below [MASK] in the
+            # framework's MLM vocab layout (config.py mlm_excluded_ids).
+            mask_id = model_cfg.input_vocab_size - 1
+            excluded = (mask_id - 2, mask_id - 1)
         inp, labels = mask_tokens(
-            tgt, r_mask, model_cfg.input_vocab_size, train_cfg.mlm_mask_rate
+            tgt, r_mask, model_cfg.input_vocab_size, train_cfg.mlm_mask_rate,
+            excluded_ids=excluded,
         )
         return inp, labels, fwd_rng
     tar_inp, tar_out = _shift_targets(tgt)
@@ -597,12 +604,16 @@ class Trainer:
         train_ds,
         test_ds=None,
         rng: jax.Array | None = None,
-        epoch_callback: Callable[[int, "Trainer"], None] | None = None,
+        epoch_callback: Callable[[int, "Trainer"], object] | None = None,
     ) -> None:
         """``epoch_callback(epoch, trainer)``, if given, runs after each
         epoch's metrics/eval/summaries and before the checkpoint save —
         the hook for in-training quality tracking (e.g. periodic BLEU in
-        ``benchmarks/bleu_run.py``)."""
+        ``benchmarks/bleu_run.py``). A truthy return value requests an
+        early stop: the epoch's checkpoint is still saved, then the loop
+        exits — the hook for metric-driven stopping rules (keep-best BLEU,
+        ``train/probe_stop.py``) that watch something other than the eval
+        loss the built-in ``early_stop_patience`` plateau rule uses."""
         cfg = self.train_cfg
         if cfg.steps_per_dispatch > 1 and self.multi_step is None:
             # Plain Trainer in eager-debug mode: no scanned step was built
@@ -748,8 +759,9 @@ class Trainer:
                     f"acc {self.train_metrics.accuracy:.4f}; "
                     f"{self.step_timer.summary()}"
                 )
+                callback_stop = False
                 if epoch_callback is not None:
-                    epoch_callback(epoch, self)
+                    callback_stop = bool(epoch_callback(epoch, self))
                 stop_early = False
                 if (
                     cfg.early_stop_patience
@@ -769,6 +781,7 @@ class Trainer:
                     (epoch + 1) % cfg.checkpoint_every_epochs == 0
                     or (epoch + 1) == cfg.epochs
                     or stop_early
+                    or callback_stop
                 ):
                     self.checkpoint.save(self.state)
                     if cfg.early_stop_patience:
@@ -780,6 +793,15 @@ class Trainer:
                         f"(best {best_eval:.4f})"
                     )
                     self._mark_early_stopped(epoch + 1)
+                    break
+                if callback_stop:
+                    # The callback owns its own stop persistence (e.g. the
+                    # probe tracker's JSON) — no EARLY_STOPPED marker here,
+                    # that file gates the plateau rule's resume path.
+                    self.log_fn(
+                        f"stop requested by epoch callback after epoch "
+                        f"{epoch + 1}"
+                    )
                     break
         if self.checkpoint is not None:
             # Async managers write in the background; don't return (or let the
