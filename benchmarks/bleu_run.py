@@ -128,7 +128,10 @@ def main() -> None:
             f"{args.seq_len}|{args.epochs}|{args.warmup}|{args.batch}|"
             f"h{args.holdout}|{args.dtype}|ls{args.label_smoothing}".encode()
         ).hexdigest()[:10]
-        args.workdir = f"/tmp/bleu_run_{key}"
+        # Repo-local, NOT /tmp: the round-4 run lost 16 banked epochs when
+        # /tmp was wiped between rounds. .bleu_runs/ is gitignored (the
+        # base-config state is ~1.1 GB) but survives on the repo volume.
+        args.workdir = os.path.join(REPO, ".bleu_runs", f"bleu_run_{key}")
     # Fail before training, not after: the scoring split must exist.
     for name in ("src-test.txt", "tgt-test.txt"):
         path = os.path.join(args.data_dir, name)

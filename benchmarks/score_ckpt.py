@@ -1,7 +1,7 @@
 """Score ANY bleu_run checkpoint (including an in-flight run's latest) on
 the held-out test split, without touching the training process.
 
-    python benchmarks/score_ckpt.py --workdir /tmp/bleu_run_<hash> \
+    python benchmarks/score_ckpt.py --workdir .bleu_runs/bleu_run_<hash> \
         --config small [--dtype float32] [--step N] [--beam 4]
 
 Prints one JSON line: {"metric": ..., "bleu": ..., "step": ..., ...}.

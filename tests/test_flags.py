@@ -282,3 +282,44 @@ def test_serve_lines_error_isolation(monkeypatch):
     assert resp[1] == {"translation": "T(good one)"}
     assert "error" in resp[2] and "decode blew up" in resp[2]["error"]
     assert resp[3] == {"translation": "T(good two)"}
+
+
+def test_distributed_cli_rejects_cpu_virtual_bf16(monkeypatch):
+    """The known XLA:CPU abort (bf16 + single-process multi-virtual-device
+    mesh, docs/ROUND4.md) must be refused with a UsageError BEFORE any
+    collective runs — a clear error + message, never a runtime abort. The
+    predicate takes jax as a parameter, so pin it in-process with a stub
+    (no XLA boot needed)."""
+    from absl import app
+
+    from transformer_tpu.cli import distributed_train as dt
+
+    class StubJax:
+        def __init__(self, backend="cpu", procs=1, ndev=4):
+            self._b, self._p, self._n = backend, procs, ndev
+
+        def default_backend(self):
+            return self._b
+
+        def process_count(self):
+            return self._p
+
+        def devices(self):
+            return [object()] * self._n
+
+    monkeypatch.delenv("TRANSFORMER_TPU_ALLOW_CPU_BF16", raising=False)
+    with pytest.raises(app.UsageError, match="float32"):
+        dt._reject_cpu_virtual_bf16(StubJax(), "bfloat16")
+
+    # fp32 on the same mesh is the supported path and must pass the guard.
+    dt._reject_cpu_virtual_bf16(StubJax(), "float32")
+
+    # bf16 is fine wherever the abort can't happen: real TPU backend,
+    # multi-host, or a single device.
+    dt._reject_cpu_virtual_bf16(StubJax(backend="tpu"), "bfloat16")
+    dt._reject_cpu_virtual_bf16(StubJax(procs=2), "bfloat16")
+    dt._reject_cpu_virtual_bf16(StubJax(ndev=1), "bfloat16")
+
+    # The escape hatch re-enables the combination for probing newer XLA.
+    monkeypatch.setenv("TRANSFORMER_TPU_ALLOW_CPU_BF16", "1")
+    dt._reject_cpu_virtual_bf16(StubJax(), "bfloat16")

@@ -30,6 +30,35 @@ from transformer_tpu.cli.flags import (
 FLAGS = flags.FLAGS
 
 
+def _reject_cpu_virtual_bf16(jax, dtype: str) -> None:
+    """Refuse the one combination known to abort inside XLA, loudly.
+
+    XLA:CPU's collective rendezvous aborts the whole process (not a Python
+    exception) when a single-process, multi-virtual-device mesh runs the
+    full fit machinery in bfloat16 (bisected in round 4; fp32 and the
+    pytest/dryrun shard_map paths are unaffected — docs/ROUND4.md). The
+    reference's precedent is its batch-divisibility ``ValueError``
+    (``distributed_train.py:154-158``): fail with a message, never abort.
+    ``TRANSFORMER_TPU_ALLOW_CPU_BF16=1`` re-enables the path for probing
+    whether a newer XLA fixed it.
+    """
+    if os.environ.get("TRANSFORMER_TPU_ALLOW_CPU_BF16") == "1":
+        return
+    if (
+        dtype == "bfloat16"
+        and jax.default_backend() == "cpu"
+        and jax.process_count() == 1
+        and len(jax.devices()) > 1
+    ):
+        raise app.UsageError(
+            "dtype=bfloat16 on a single-process multi-device CPU mesh "
+            f"({len(jax.devices())} virtual devices) aborts in XLA:CPU's "
+            "collective rendezvous (known backend bug, docs/ROUND4.md). "
+            "Pass --dtype=float32 for CPU runs, or set "
+            "TRANSFORMER_TPU_ALLOW_CPU_BF16=1 to try anyway."
+        )
+
+
 def main(argv) -> None:
     del argv
     from transformer_tpu.cli.flags import apply_preset
@@ -46,6 +75,7 @@ def main(argv) -> None:
     from transformer_tpu.train.decode import translate
 
     initialize_distributed()
+    _reject_cpu_virtual_bf16(jax, FLAGS.dtype)
     mesh_cfg = flags_to_mesh_config(len(jax.devices()))
     mesh = make_mesh(mesh_cfg)
     logging.info(
