@@ -1,0 +1,155 @@
+"""Serving-path microbenchmark: prefill tokens/sec vs incremental decode.
+
+CPU-runnable on purpose — serving-perf PRs need a number even while the TPU
+relay is down (bench.py measures the training hot path on real hardware; this
+measures the SHAPE of the serving hot path, which survives the platform: the
+prompt phase is matmul-rich and batched, the decode phase is one
+bandwidth-bound step per token, per "Fast Transformer Decoding" (Shazeer,
+arXiv:1911.02150)).
+
+    JAX_PLATFORMS=cpu python benchmarks/decode_bench.py
+
+Prints ONE JSON line:
+
+    {"prefill_tokens_per_sec": ..., "decode_tokens_per_sec": ...,
+     "decode_steps_per_sec": ..., "prefill_vs_decode": ...,
+     "prefill_forward_calls": ...}
+
+``prefill_vs_decode`` is the headline: how many times faster the single-pass
+chunked prefill ingests a prompt token than the token-by-token decode loop
+does. ``prefill_forward_calls`` pins the structural claim — a 64-token
+prompt compiles to ceil(prompt_len / chunk) decoder forwards, not 64
+sequential steps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt_len", type=int, default=64)
+    p.add_argument("--decode_steps", type=int, default=32)
+    p.add_argument("--chunk", type=int, default=0,
+                   help="prefill chunk size (0 = whole prompt in one forward)")
+    p.add_argument("--reps", type=int, default=5,
+                   help="timed repetitions (best-of is reported)")
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--d_model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--dff", type=int, default=512)
+    p.add_argument("--vocab", type=int, default=8192)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.models import transformer_init
+    from transformer_tpu.models.decoder import init_decoder_caches
+    from transformer_tpu.models.transformer import (
+        transformer_decode_step,
+        transformer_prefill,
+    )
+
+    total = args.prompt_len + args.decode_steps + 1
+    cfg = ModelConfig(
+        num_layers=args.layers, d_model=args.d_model, num_heads=args.heads,
+        dff=args.dff, input_vocab_size=args.vocab, target_vocab_size=args.vocab,
+        max_position=total, decoder_only=True, tie_output=True,
+        dtype="float32", dropout_rate=0.0,
+    )
+    dev = jax.devices()[0]
+    print(f"decode bench on {dev.platform}:{dev.device_kind}", file=sys.stderr)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, args.vocab - 2, (args.batch, args.prompt_len)),
+        jnp.int32,
+    )
+
+    calls = [0]
+    prefill = jax.jit(
+        lambda params, prompt, caches: transformer_prefill(
+            params, prompt, None, None, caches, 0, cfg, chunk=args.chunk
+        ),
+        static_argnames=(),
+    )
+
+    # Count the decoder forwards the prefill TRACES to (the structural
+    # O(prompt_len / chunk) claim) by intercepting decoder_apply once.
+    from transformer_tpu.models import decoder as decoder_mod
+
+    real_apply = decoder_mod.decoder_apply
+
+    def counting_apply(*a, **kw):
+        calls[0] += 1
+        return real_apply(*a, **kw)
+
+    decoder_mod.decoder_apply = counting_apply
+    try:
+        caches0 = init_decoder_caches(cfg, args.batch, total)
+        logits, caches = prefill(params, prompt, caches0)
+        jax.block_until_ready(logits)
+    finally:
+        decoder_mod.decoder_apply = real_apply
+    prefill_calls = calls[0]
+
+    best = float("inf")
+    for _ in range(args.reps):
+        caches0 = init_decoder_caches(cfg, args.batch, total)
+        t0 = time.perf_counter()
+        logits, caches = prefill(params, prompt, caches0)
+        jax.block_until_ready(logits)
+        best = min(best, time.perf_counter() - t0)
+    prefill_tok_s = args.batch * args.prompt_len / best
+
+    # Incremental decode: one bandwidth-bound step per token from the
+    # prefilled cache (greedy feedback keeps the loop honest — each step
+    # consumes the previous step's output, like serving does).
+    step = jax.jit(
+        lambda params, tok, caches, pos: transformer_decode_step(
+            params, tok, None, None, caches, pos, cfg
+        )
+    )
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    _, warm = step(params, tok, caches, jnp.int32(args.prompt_len))
+    jax.block_until_ready(warm[0]["k"])
+
+    best_dec = float("inf")
+    for _ in range(args.reps):
+        t, c = tok, caches
+        t0 = time.perf_counter()
+        for i in range(args.decode_steps):
+            logits_i, c = step(params, t, c, jnp.int32(args.prompt_len + i))
+            t = jnp.argmax(logits_i, axis=-1).astype(jnp.int32)[:, None]
+        jax.block_until_ready(t)
+        best_dec = min(best_dec, time.perf_counter() - t0)
+    decode_steps_s = args.decode_steps / best_dec
+    decode_tok_s = args.batch * args.decode_steps / best_dec
+
+    print(json.dumps({
+        "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+        "decode_tokens_per_sec": round(decode_tok_s, 1),
+        "decode_steps_per_sec": round(decode_steps_s, 1),
+        "prefill_vs_decode": round(prefill_tok_s / decode_tok_s, 2),
+        "prefill_forward_calls": prefill_calls,
+        "batch": args.batch,
+        "prompt_len": args.prompt_len,
+        "decode_steps": args.decode_steps,
+        "chunk": args.chunk,
+        "device": f"{dev.platform}:{dev.device_kind}",
+    }))
+
+
+if __name__ == "__main__":
+    main()

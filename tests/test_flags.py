@@ -148,6 +148,69 @@ export_params(transformer_init(jax.random.PRNGKey(0), cfg), cfg, r"{tmp_path}/mo
     assert "error" in lines[2]
 
 
+@pytest.mark.slow  # heavyweight: slow tier (test_scheduler.py covers fast)
+def test_serve_continuous_end_to_end(tmp_path):
+    """cli.serve with a decoder-only export: the continuous-batching path
+    (--serve_slots, the LM default) answers mixed prompt requests, a raw
+    line, and a malformed line — one JSONL response per request, in order,
+    identical to a --serve_slots=0 (grouped) run of the same requests."""
+    import json
+
+    build = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.models import transformer_init
+from transformer_tpu.train.checkpoint import export_params
+from transformer_tpu.data.tokenizer import SubwordTokenizer
+tok = SubwordTokenizer.build_from_corpus(["ab cd ef gh"] * 3, target_vocab_size=270)
+tok.save(r"{tmp_path}/vocab.subwords")
+cfg = ModelConfig(num_layers=1, d_model=16, num_heads=2, dff=32,
+                  input_vocab_size=tok.model_vocab_size,
+                  target_vocab_size=tok.model_vocab_size,
+                  max_position=32, decoder_only=True, tie_output=True,
+                  dtype="float32", dropout_rate=0.0)
+export_params(transformer_init(jax.random.PRNGKey(0), cfg), cfg, r"{tmp_path}/model")
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", build],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    requests = (
+        'ab cd\n'
+        '{"prompt": "ef gh", "max_new": 3}\n'
+        '{"prompt": "ab", "max_new": 8, "temperature": 0.8, "seed": 2}\n'
+        '{broken\n'
+    )
+
+    def serve(extra):
+        r = subprocess.run(
+            [sys.executable, "-m", "transformer_tpu.cli.serve",
+             "--platform=cpu",
+             f"--export_path={tmp_path}/model",
+             f"--tgt_vocab_file={tmp_path}/vocab.subwords",
+             "--max_len=4", *extra],
+            input=requests, capture_output=True, text=True, timeout=300,
+            env=env,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        return [json.loads(l) for l in r.stdout.strip().splitlines()]
+
+    cont = serve(["--serve_slots=2", "--prefill_chunk=4"])
+    assert len(cont) == 4
+    assert "continuation" in cont[0] and "continuation" in cont[1]
+    assert "continuation" in cont[2] and "error" in cont[3]
+    # Same answers as the grouped decode-to-completion path.
+    grouped = serve(["--serve_slots=0"])
+    assert [c.get("continuation") for c in cont[:3]] == [
+        g.get("continuation") for g in grouped[:3]
+    ]
+
+
 def test_serve_lines_batches_one_decode_per_group(monkeypatch):
     """>=2 concurrent requests with the same decode signature must go
     through ONE translate() call (the batched-serving contract); different
@@ -248,6 +311,46 @@ def test_serve_lines_fill_mask(monkeypatch):
         ['{"src": "hello", "fill": "stray"}'], None, seq_cfg, None, None
     )
     assert resp[0] == {"translation": "T(hello)"}
+
+
+def test_serve_lines_sampled_requests_run_batch1(monkeypatch):
+    """Greedy LM requests with one signature batch into ONE generate call;
+    SAMPLED requests must each run alone — lm_generate holds one rng for a
+    whole batch, so a co-batched sampled request's draws would depend on
+    its neighbors (and diverge from the continuous scheduler's per-row
+    picks)."""
+    from transformer_tpu.cli import serve as serve_mod
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.train import decode as decode_mod
+
+    calls = []
+
+    def fake_generate(params, cfg, tok, prompts, **kw):
+        calls.append((tuple(prompts), kw.get("temperature"), kw.get("seed")))
+        return [f"G({p})" for p in prompts]
+
+    monkeypatch.setattr(decode_mod, "generate", fake_generate)
+    cfg = ModelConfig(
+        num_layers=1, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=32, target_vocab_size=32, max_position=16,
+        decoder_only=True, tie_output=True,
+    )
+    resp = serve_mod.serve_lines(
+        [
+            '{"prompt": "a"}',                                # greedy group
+            '{"prompt": "b", "temperature": 0.8, "seed": 2}', # alone
+            '{"prompt": "c", "seed": 7}',  # greedy ignores seed: same group
+            '{"prompt": "d", "temperature": 0.8, "seed": 2}', # alone
+        ],
+        None, cfg, None, None,
+    )
+    assert [r["continuation"] for r in resp] == [
+        "G(a)", "G(b)", "G(c)", "G(d)"
+    ]
+    greedy = [c for c in calls if c[1] == 0.0]
+    sampled = [c for c in calls if c[1] == 0.8]
+    assert greedy == [(("a", "c"), 0.0, 0)]
+    assert sorted(s[0] for s in sampled) == [("b",), ("d",)]
 
 
 def test_serve_lines_error_isolation(monkeypatch):

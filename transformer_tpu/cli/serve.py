@@ -28,6 +28,15 @@ process:
   latency), groups them by decode signature (kind + max_len + beam /
   sampling params), and runs ONE decode per group. Concurrent clients
   share the chip instead of serializing through batch-1 decodes.
+
+Decoder-only (LM) exports additionally get **continuous batching**
+(``--serve_slots``, default on): instead of decoding each drained batch to
+completion, a step-level scheduler advances a fixed pool of KV-cache slots
+one token per tick, retiring finished requests and admitting queued ones
+mid-flight via single-pass chunked prefill (``--prefill_chunk``) — a
+straggler with a long generation no longer holds a whole batch's chip time
+hostage. ``--serve_slots=0`` restores the grouped decode-to-completion
+path. See docs/SERVING.md.
 """
 
 from __future__ import annotations
@@ -50,6 +59,22 @@ def define_serve_flags() -> None:
         "serve_batch", 8,
         "max already-queued requests aggregated into one decode (grouped by "
         "decode signature; 1 = the old request-at-a-time behavior)")
+    flags.DEFINE_integer(
+        "serve_slots", 8,
+        "KV-cache slots for continuous (in-flight) batching of decoder-only "
+        "LM requests: finished requests retire at step boundaries and queued "
+        "ones are admitted mid-flight via chunked prefill. 0 = grouped "
+        "decode-to-completion batching (the --serve_batch path). Ignored for "
+        "seq2seq / fill-mask exports, which always use the grouped path.")
+    flags.DEFINE_integer(
+        "serve_max_total", 0,
+        "per-slot KV budget (prompt + generated tokens) for continuous "
+        "batching; 0 sizes it to the model's max_position")
+    flags.DEFINE_integer(
+        "prefill_chunk", 0,
+        "split prompt prefill into chunks of this many tokens so activation "
+        "memory stays bounded at long prompt lengths (0 = whole prompt in "
+        "one forward); also used by grouped-path generate()")
 
 
 def _parse_line(line: str, model_cfg) -> dict:
@@ -92,12 +117,19 @@ def _signature(
     if "prompt" in req:
         if not model_cfg.decoder_only:
             return None
+        temperature = float(req.get("temperature", 0.0))
         return (
             "prompt",
             int(req.get("max_new", default_max_len)),
-            float(req.get("temperature", 0.0)),
+            temperature,
             int(req.get("top_k", 0)),
             float(req.get("top_p", 1.0)),
+            # Per-request sampling seed: part of the signature because one
+            # generate() call holds ONE rng for the whole batch (the
+            # continuous scheduler honors seeds per-request; grouped serving
+            # must answer seeded requests identically). Greedy decode never
+            # touches the rng, so a stray seed must not split its groups.
+            int(req.get("seed", 0)) if temperature > 0.0 else 0,
         )
     return None
 
@@ -105,6 +137,7 @@ def _signature(
 def serve_lines(
     lines: list[str], params, model_cfg, src_tok, tgt_tok,
     default_max_len: int = 64, default_beam: int = 1,
+    prefill_chunk: int = 0,
 ) -> list[dict]:
     """Answer a batch of request lines with one decode per signature group,
     preserving input order. Pure function of its inputs — the unit the
@@ -127,6 +160,13 @@ def serve_lines(
         except Exception as e:  # noqa: BLE001 — bad line answers, never kills
             responses[i] = {"error": f"{type(e).__name__}: {e}"}
             continue
+        if sig is not None and sig[0] == "prompt" and sig[2] > 0.0:
+            # Sampled LM requests run batch-1: one lm_generate rng serves a
+            # whole batch, so a co-batched sampled request's draws would
+            # depend on its neighbors — the answer to a seeded request must
+            # not change with traffic (and must match the continuous
+            # scheduler, which picks per-row).
+            sig = (*sig, i)
         if sig is None:
             sent = next(
                 (k for k in ("src", "prompt", "fill") if k in req), None
@@ -168,12 +208,14 @@ def serve_lines(
                 max_len=max_len, beam_size=beam,
             )
             return [{"translation": out} for out in outs]
-        _, max_new, temperature, top_k, top_p = sig
+        # Sampled signatures carry a trailing per-request discriminator
+        # (batch-1 semantics above) — slice the decode params off the front.
+        _, max_new, temperature, top_k, top_p, seed = sig[:6]
         outs = generate(
             params, model_cfg, tgt_tok,
             [str(req["prompt"]) for _, req in members],
             max_new=max_new, temperature=temperature,
-            top_k=top_k, top_p=top_p,
+            top_k=top_k, top_p=top_p, seed=seed, prefill_chunk=prefill_chunk,
         )
         return [{"continuation": out} for out in outs]
 
@@ -196,6 +238,74 @@ def serve_lines(
         r if r is not None else {"error": "internal: unanswered"}
         for r in responses
     ]
+
+
+class _RoutingError(ValueError):
+    """Kind-mismatch the grouped path answers with the BARE message (its
+    sig-is-None branch builds the response directly, no exception-type
+    prefix) — serve_continuous must answer it the same way."""
+
+
+def _route_lm_request(line: str, model_cfg) -> dict:
+    """One stdin line -> LM request dict for the continuous scheduler
+    (raises with the same message shapes ``serve_lines`` answers with)."""
+    req = _parse_line(line, model_cfg)
+    # Mirror _signature's key precedence exactly — 'src' rejects even when
+    # 'prompt' is also present, a stray 'fill' next to 'prompt' is ignored —
+    # so --serve_slots=0 and the continuous path answer any given line the
+    # same way.
+    if "src" in req:
+        raise _RoutingError("LM export serves 'prompt', not 'src'")
+    if "prompt" not in req:
+        if "fill" in req:
+            raise _RoutingError("LM export serves 'prompt', not 'fill'")
+        raise _RoutingError(
+            "request needs 'src' (seq2seq), 'prompt' (LM) or "
+            "'fill' (masked-LM)"
+        )
+    return req
+
+
+def serve_continuous(q: queue.Queue, sched, model_cfg) -> None:
+    """Drive the continuous-batching scheduler from the stdin queue: ingest
+    whatever is already queued (malformed lines answer immediately via a
+    reserved output position — ordering is preserved), admit queued requests
+    into free slots, advance every occupied slot one token, flush responses
+    completed in arrival order. Blocks on stdin ONLY when nothing is
+    in-flight and nothing is waiting to flush — an in-flight request never
+    waits on a quiet client. Ingestion stops while the scheduler's backlog
+    plus its unflushed responses reach the cap, so the reader thread's
+    bounded queue keeps exerting stdin backpressure (a piped multi-GB
+    request file must not accumulate in the scheduler's host-side queue —
+    and a flood of instantly error-answered lines must not accumulate in
+    its done-buffer — either)."""
+    eof = False
+    backlog_cap = max(1, sched.num_slots) * 8
+    while not eof or sched.busy:
+        while not eof and sched.backlog + sched.ready_count < backlog_cap:
+            try:
+                line = q.get(block=not (sched.busy or sched.has_ready))
+            except queue.Empty:
+                break
+            if line is None:
+                eof = True
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = _route_lm_request(line, model_cfg)
+            except _RoutingError as e:
+                sched.submit_done({"error": str(e)})
+                continue
+            except Exception as e:  # noqa: BLE001 — bad line answers, never kills
+                sched.submit_done({"error": f"{type(e).__name__}: {e}"})
+                continue
+            sched.submit(req)
+        sched.admit()
+        sched.step()
+        for resp in sched.drain_ready():
+            print(json.dumps(resp), flush=True)
 
 
 def _stdin_reader(q: queue.Queue) -> None:
@@ -225,12 +335,16 @@ def main(argv) -> None:
             if FLAGS.tgt_vocab_file == FLAGS.src_vocab_file
             else SubwordTokenizer.load(FLAGS.tgt_vocab_file)
         )
+    continuous = model_cfg.decoder_only and FLAGS.serve_slots > 0
     logging.info(
-        "serving %s from %s; one JSONL request per stdin line, batching up "
-        "to %d queued requests per decode",
+        "serving %s from %s; one JSONL request per stdin line, %s",
         "fill-mask" if model_cfg.encoder_only
         else "LM" if model_cfg.decoder_only else "seq2seq",
-        FLAGS.export_path, max(1, FLAGS.serve_batch),
+        FLAGS.export_path,
+        f"continuous batching over {FLAGS.serve_slots} cache slots"
+        if continuous
+        else f"batching up to {max(1, FLAGS.serve_batch)} queued requests "
+        "per decode",
     )
 
     # Bounded queue: the reader thread blocks on put() once it is this far
@@ -238,6 +352,18 @@ def main(argv) -> None:
     # piped multi-GB request file must not accumulate in host memory.
     q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_batch) * 8)
     threading.Thread(target=_stdin_reader, args=(q,), daemon=True).start()
+    if continuous:
+        from transformer_tpu.serve import ContinuousScheduler
+
+        sched = ContinuousScheduler(
+            params, model_cfg, tgt_tok,
+            num_slots=FLAGS.serve_slots,
+            max_total=FLAGS.serve_max_total or None,
+            prefill_chunk=FLAGS.prefill_chunk,
+            default_max_new=FLAGS.max_len,
+        )
+        serve_continuous(q, sched, model_cfg)
+        return
     eof = False
     while not eof:
         first = q.get()
@@ -262,6 +388,7 @@ def main(argv) -> None:
         for resp in serve_lines(
             lines, params, model_cfg, src_tok, tgt_tok,
             default_max_len=FLAGS.max_len, default_beam=FLAGS.beam,
+            prefill_chunk=FLAGS.prefill_chunk,
         ):
             print(json.dumps(resp), flush=True)
 

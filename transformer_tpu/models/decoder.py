@@ -204,12 +204,56 @@ def decoder_apply(
     return x, attn_weights, new_caches
 
 
+def decoder_prefill(
+    params: Params,
+    tokens: jax.Array,
+    enc_out: jax.Array | None,
+    cross_mask: jax.Array | None,
+    caches: list[dict[str, Any]],
+    cfg: ModelConfig,
+    cross_kvs: list[tuple[jax.Array, jax.Array]] | None = None,
+    start: jax.Array | int = 0,
+    chunk: int = 0,
+) -> tuple[jax.Array, list[dict[str, Any]]]:
+    """Single-pass teacher-forced prefill: run ``tokens`` (B, n) — sitting at
+    absolute positions ``start .. start + n - 1`` — through the full decoder
+    forward, writing every position's K/V into ``caches`` (the cache write
+    API accepts S_q > 1; ``ops/attention.py`` builds the offset causal mask
+    of a chunk attending into the cached prefix). Returns ((B, d_model)
+    hidden state of the LAST position, updated caches).
+
+    ``chunk > 0`` splits the pass into ceil(n / chunk) forward calls so
+    activation memory stays bounded at long prompt lengths — the compiled
+    program is O(n / chunk) matmul-rich forwards, never O(n) sequential
+    decode steps. Rolling-window caches cap the chunk at the window buffer
+    length (an attention-layer invariant — see ``mha_apply``)."""
+    n = tokens.shape[1]
+    if n < 1:
+        raise ValueError(f"prefill needs at least one token, got {n}")
+    chunk = chunk if chunk > 0 else n  # <= 0 = whole pass in one chunk
+    if caches and "rolling" in caches[0]:
+        chunk = min(chunk, caches[0]["k"].shape[1])
+    x_last = None
+    for off in range(0, n, chunk):
+        width = min(chunk, n - off)
+        x, _, caches = decoder_apply(
+            params, jax.lax.slice_in_dim(tokens, off, off + width, axis=1),
+            enc_out, None, cross_mask, cfg,
+            rng=None, deterministic=True, caches=caches, cross_kvs=cross_kvs,
+            position_offset=start + off,
+        )
+        x_last = x[:, -1, :]
+    return x_last, caches
+
+
 def init_decoder_caches(
     cfg: ModelConfig, batch_size: int, max_len: int
 ) -> list[dict[str, Any]]:
     """One self-attention KV cache per decoder layer (int8-quantized when
     ``cfg.kv_cache_int8``; a rolling O(window) buffer when
-    ``cfg.attention_window``)."""
+    ``cfg.attention_window``). Caches start at position 0; fill the prompt
+    in one pass with ``decoder_prefill`` and decode incrementally from
+    there (``transformer_decode_step``)."""
     return [
         init_cache(
             batch_size, max_len, cfg.kv_heads, cfg.head_dim,

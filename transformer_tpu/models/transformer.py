@@ -147,6 +147,36 @@ def transformer_apply(
     return _logits(params, x, cfg), attn
 
 
+def transformer_prefill(
+    params: Params,
+    tokens: jax.Array,
+    enc_out: jax.Array | None,
+    cross_mask: jax.Array | None,
+    caches: list[dict[str, Any]],
+    position: jax.Array | int,
+    cfg: ModelConfig,
+    cross_kvs: list[tuple[jax.Array, jax.Array]] | None = None,
+    chunk: int = 0,
+) -> tuple[jax.Array, list[dict[str, Any]]]:
+    """Single-pass prompt ingestion: (B, n) tokens at absolute positions
+    ``position .. position + n - 1`` -> ((B, vocab) logits for the NEXT
+    position, caches holding every prompt position's K/V).
+
+    The serving-side counterpart of ``transformer_decode_step``: where the
+    step consumes ONE token per bandwidth-bound call, prefill consumes the
+    whole prompt (in ``chunk``-sized pieces when ``chunk > 0``) through the
+    teacher-forcing forward — O(n / chunk) MXU-saturating matmuls instead of
+    O(n) sequential steps. Only the last position is projected to the vocab,
+    so the (B, n, V) logits tensor is never materialized."""
+    from transformer_tpu.models.decoder import decoder_prefill
+
+    x_last, new_caches = decoder_prefill(
+        params["decoder"], tokens, enc_out, cross_mask, caches, cfg,
+        cross_kvs=cross_kvs, start=position, chunk=chunk,
+    )
+    return _logits(params, x_last[:, None, :], cfg)[:, -1, :], new_caches
+
+
 def transformer_decode_step(
     params: Params,
     token: jax.Array,

@@ -237,6 +237,7 @@ def mha_apply(
     if cache is not None:
         idx = cache["index"]
         buf_len = cache["k"].shape[1]
+        s_q = x_q.shape[1]
         # Rolling window buffer (init_cache(window=...)): the buffer holds
         # only the last `buf_len <= window` positions and each step writes
         # slot idx % buf_len — decode HBM and score compute are O(window),
@@ -249,12 +250,19 @@ def mha_apply(
         # stays trace-time. Inferring it from buffer size would misclassify
         # a full-length cache as rolling whenever max_len <= window.
         rolling = "rolling" in cache
-        if rolling:
-            if x_q.shape[1] != 1:
+        if rolling and s_q > 1:
+            # Chunked PREFILL into a rolling buffer. Writing the chunk first
+            # and then attending the buffer (the one-token flow) would be
+            # wrong here: a later chunk token's write can evict a position
+            # that is still inside an earlier chunk token's band. So attend
+            # FIRST — against the buffer's pre-chunk contents plus the
+            # chunk's own keys — then write. Chunks are capped at buf_len so
+            # the write slots are distinct (no intra-chunk eviction).
+            if s_q > buf_len:
                 raise ValueError(
-                    "rolling-window cache decodes one token per step; "
-                    f"got s_q={x_q.shape[1]} (prefill feeds tokens through "
-                    "the decode scan one at a time)"
+                    f"rolling-window prefill chunks must fit the window "
+                    f"buffer: got s_q={s_q} > buf_len={buf_len} (split the "
+                    "prefill into chunks of at most the window size)"
                 )
             if mask is not None:
                 raise ValueError(
@@ -262,54 +270,74 @@ def mha_apply(
                     "caller mask is indexed by absolute position and "
                     "cannot compose with rotated slots"
                 )
-            write_pos = idx % buf_len
+            from transformer_tpu.ops.masks import make_rolling_prefill_mask
+
+            if "k_scale" in cache:
+                k_old = cache["k"].astype(dtype) * cache["k_scale"].astype(dtype)
+                v_old = cache["v"].astype(dtype) * cache["v_scale"].astype(dtype)
+            else:
+                k_old = cache["k"].astype(dtype)
+                v_old = cache["v"].astype(dtype)
+            mask = make_rolling_prefill_mask(idx, s_q, buf_len)
+            slots_w = (idx + jnp.arange(s_q)) % buf_len
+            new_cache, k, v = _store_kv(
+                cache, k, v, lambda buf, val: buf.at[:, slots_w].set(val)
+            )
+            new_cache["index"] = idx + s_q
+            new_cache["rolling"] = cache["rolling"]
+            cache = new_cache
+            k = jnp.concatenate([k_old, k], axis=1)
+            v = jnp.concatenate([v_old, v], axis=1)
         else:
-            write_pos = idx
-        if "k_scale" in cache:
-            # int8 KV cache (init_cache(quantize=True)): store each new
+            if rolling:
+                if mask is not None:
+                    raise ValueError(
+                        "rolling-window cache builds its own slot mask; a "
+                        "caller mask is indexed by absolute position and "
+                        "cannot compose with rotated slots"
+                    )
+                write_pos = idx % buf_len
+            else:
+                write_pos = idx
+            # int8 caches (init_cache(quantize=True)) store each new
             # (position, head) row as int8 with its own fp32 scale — the
             # cache is the decode-side HBM bottleneck at long contexts, and
             # int8 reads cost 2x (vs bf16) to 4x (vs fp32) less bandwidth.
             # Dequantize below for the attention math (compute stays in the
             # model dtype; the win is memory, not FLOPs).
-            kq, ks = _quantize_kv(k)
-            vq, vs = _quantize_kv(v)
-            new_cache = {
-                "k": jax.lax.dynamic_update_slice(cache["k"], kq, (0, write_pos, 0, 0)),
-                "k_scale": jax.lax.dynamic_update_slice(cache["k_scale"], ks, (0, write_pos, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(cache["v"], vq, (0, write_pos, 0, 0)),
-                "v_scale": jax.lax.dynamic_update_slice(cache["v_scale"], vs, (0, write_pos, 0, 0)),
-                "index": idx + x_q.shape[1],
-            }
-            k = new_cache["k"].astype(dtype) * new_cache["k_scale"].astype(dtype)
-            v = new_cache["v"].astype(dtype) * new_cache["v_scale"].astype(dtype)
-        else:
-            k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, write_pos, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, write_pos, 0, 0))
-            new_cache = {"k": k, "v": v, "index": idx + x_q.shape[1]}
-        if rolling:
-            new_cache["rolling"] = cache["rolling"]
-        cache = new_cache
-        if rolling:
-            # Which slots hold a REAL (already-written) position: all of
-            # them once idx wraps, else slots <= idx. Every held position
-            # is inside the band by construction (the newest write evicted
-            # the only out-of-band one).
-            slots = jnp.arange(buf_len)[None, None, None, :]
-            mask = jnp.logical_or(slots <= idx, idx >= buf_len)
-        else:
-            # Causal decode mask over the cache buffer: new query at
-            # absolute position idx+i may attend keys at positions <= idx+i
-            # (prefill with s_q > 1 stays causal), combined with any
-            # caller-provided mask.
-            positions = jnp.arange(buf_len)[None, None, None, :]
-            q_pos = idx + jnp.arange(x_q.shape[1])[None, None, :, None]
-            valid = positions <= q_pos
-            if window:
-                # Sliding window over a FULL-LENGTH cache (window set but
-                # the cache was built without it): mask the band only.
-                valid = jnp.logical_and(valid, positions > q_pos - window)
-            mask = valid if mask is None else jnp.logical_and(mask, valid)
+            new_cache, _, _ = _store_kv(
+                cache, k, v,
+                lambda buf, val: jax.lax.dynamic_update_slice(
+                    buf, val, (0, write_pos, 0, 0)
+                ),
+            )
+            new_cache["index"] = idx + s_q
+            if "k_scale" in cache:
+                k = new_cache["k"].astype(dtype) * new_cache["k_scale"].astype(dtype)
+                v = new_cache["v"].astype(dtype) * new_cache["v_scale"].astype(dtype)
+            else:
+                k = new_cache["k"]
+                v = new_cache["v"]
+            if rolling:
+                new_cache["rolling"] = cache["rolling"]
+            cache = new_cache
+            if rolling:
+                # Which slots hold a REAL (already-written) position: all of
+                # them once idx wraps, else slots <= idx. Every held position
+                # is inside the band by construction (the newest write evicted
+                # the only out-of-band one).
+                slots = jnp.arange(buf_len)[None, None, None, :]
+                mask = jnp.logical_or(slots <= idx, idx >= buf_len)
+            else:
+                # Causal decode mask over the cache buffer: new query at
+                # absolute position idx+i may attend keys at positions <= idx+i
+                # (prefill with s_q > 1 stays causal), combined with any
+                # caller-provided mask. `window` masks the band when a sliding
+                # window runs over a FULL-LENGTH (non-rolling) cache.
+                from transformer_tpu.ops.masks import make_cache_prefix_mask
+
+                valid = make_cache_prefix_mask(idx, s_q, buf_len, window=window)
+                mask = valid if mask is None else jnp.logical_and(mask, valid)
         k = k.astype(dtype)
         v = v.astype(dtype)
 
@@ -385,6 +413,41 @@ def _quantize_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
     scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
     q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale), -127, 127)
     return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _store_kv(cache, k, v, write):
+    """Write new (B, S_q, H, D) k/v into a decode cache's buffers via
+    ``write(buf, val) -> buf`` (the caller picks the scatter: rolling slots
+    or a contiguous dynamic_update_slice). The ONE place that knows the int8
+    layout — quantizing into the four k/k_scale/v/v_scale buffers — so the
+    prefill and one-token write paths can never desynchronize numerics.
+
+    Returns ``(new_cache_bufs, k_rt, v_rt)``: the updated buffers (no
+    "index"/"rolling" bookkeeping — callers own that) plus the new entries
+    as the read path will see them — the quantize->dequantize round trip for
+    int8 caches, the inputs unchanged otherwise. Attending the chunk's own
+    keys through ``k_rt`` keeps int8 decode numerics independent of whether
+    a position arrived via prefill or step."""
+    if "k_scale" in cache:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        new = {
+            "k": write(cache["k"], kq),
+            "k_scale": write(cache["k_scale"], ks),
+            "v": write(cache["v"], vq),
+            "v_scale": write(cache["v_scale"], vs),
+        }
+        dtype = k.dtype
+        return (
+            new,
+            kq.astype(dtype) * ks.astype(dtype),
+            vq.astype(dtype) * vs.astype(dtype),
+        )
+    new = {
+        "k": write(cache["k"], k.astype(cache["k"].dtype)),
+        "v": write(cache["v"], v.astype(cache["v"].dtype)),
+    }
+    return new, k, v
 
 
 def init_cache(

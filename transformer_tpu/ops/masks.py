@@ -47,6 +47,47 @@ def make_causal_mask(seq_len: int, window: int = 0) -> jax.Array:
     return mask[None, None, :, :]
 
 
+def make_cache_prefix_mask(
+    index: jax.Array, s_q: int, buf_len: int, window: int = 0
+) -> jax.Array:
+    """(1, 1, s_q, buf_len) bool: the offset causal mask of a prefill chunk
+    attending into a partially-filled full-length decode cache. Query i sits
+    at absolute position ``index + i`` and may attend buffer position j iff
+    ``j <= index + i`` — so a chunk of S_q prompt tokens stays causal against
+    both the already-cached prefix and itself. ``window > 0`` additionally
+    bounds each query to the last ``window`` positions (the banded form used
+    when ``attention_window`` is set on a full-length cache)."""
+    positions = jnp.arange(buf_len)[None, None, None, :]
+    q_pos = index + jnp.arange(s_q)[None, None, :, None]
+    valid = positions <= q_pos
+    if window:
+        valid = jnp.logical_and(valid, positions > q_pos - window)
+    return valid
+
+
+def make_rolling_prefill_mask(
+    index: jax.Array, s_q: int, buf_len: int
+) -> jax.Array:
+    """(1, 1, s_q, buf_len + s_q) bool mask for a prefill chunk attending a
+    ROLLING window cache: the first ``buf_len`` key columns are the buffer's
+    pre-chunk slots, the last ``s_q`` columns are the chunk's own keys.
+
+    Buffer slot s last held absolute position ``p_old(s)`` — the largest
+    ``p < index`` with ``p % buf_len == s`` (negative = never written). Query
+    i (absolute position ``index + i``) may attend slot s iff ``p_old(s)``
+    is real and inside its band ``(index + i - buf_len, index + i]``; chunk
+    key j (absolute position ``index + j``) iff ``j <= i`` (``j > i -
+    buf_len`` holds by construction since chunks are capped at ``buf_len``).
+    This reproduces, position for position, what the one-token-per-step
+    rolling path would have attended at each tick."""
+    slots = jnp.arange(buf_len)[None, :]
+    p_old = (index - 1) - ((index - 1 - slots) % buf_len)
+    q_pos = index + jnp.arange(s_q)[:, None]
+    old_ok = jnp.logical_and(p_old >= 0, p_old > q_pos - buf_len)
+    chunk_ok = jnp.arange(s_q)[None, :] <= jnp.arange(s_q)[:, None]
+    return jnp.concatenate([old_ok, chunk_ok], axis=1)[None, None]
+
+
 def make_seq2seq_masks(
     inp: jax.Array, tar: jax.Array, pad_id: int = PAD_ID
 ) -> tuple[jax.Array, jax.Array, jax.Array]:

@@ -1,0 +1,6 @@
+"""Serving-side scheduling: continuous (in-flight) batching over a fixed
+pool of KV-cache slots (``transformer_tpu/serve/scheduler.py``)."""
+
+from transformer_tpu.serve.scheduler import ContinuousScheduler, SlotPool
+
+__all__ = ["ContinuousScheduler", "SlotPool"]

@@ -1,0 +1,426 @@
+"""Continuous (in-flight) batching for decoder-only LM serving.
+
+The grouped path in ``cli/serve.py`` decodes each drained batch TO
+COMPLETION before any newly queued request gets a slot: one straggler with
+a long generation holds an entire batch's worth of chip time hostage, and a
+request that arrives one tick after a batch launches waits out the whole
+batch. This module replaces that with a step-level scheduler over a fixed
+pool of KV-cache slots:
+
+- **Slot pool**: ``num_slots`` independent single-request KV caches stacked
+  into one device-resident pytree (leading slot axis). One jitted
+  ``_pool_step`` advances EVERY slot one token per call (a vmapped
+  ``transformer_decode_step`` — each slot carries its own cache index, so
+  slots sit at unrelated positions in unrelated requests).
+- **Admission by prefill-into-slot**: a newly queued request claims a free
+  slot mid-flight; its prompt is ingested in one chunked
+  ``transformer_prefill`` pass into that slot's cache (the slot's index is
+  reset — stale K/V from the previous occupant is provably invisible, the
+  position mask zeroes anything at positions the new request has not
+  written). Prefill lengths are bucketed (``prefill_len_for``) so serving
+  never recompiles per prompt length.
+- **Retirement at step boundaries**: a slot that emits EOS (or exhausts its
+  ``max_new`` budget) is retired and recycled at the next step boundary; the
+  remaining slots never wait for it.
+
+Outputs are bit-identical to ``serve_batch=1`` sequential serving (each
+request alone through ``train.decode.generate``): the per-slot decode is the
+same cached step at the same positions, picks go through the same
+``sample_token`` with the same position-keyed rng folding, and masked cache
+slots contribute exactly zero to attention regardless of their stale
+content. ``tests/test_scheduler.py`` pins this.
+
+Per-request error isolation (the ``cli/serve.py`` grouped-path guarantee)
+holds structurally here: requests fail at admission (encode/validation) —
+one poisoned request answers with its error and never enters the pool, so
+co-batched requests are untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transformer_tpu.config import PAD_ID, ModelConfig
+from transformer_tpu.models.decoder import init_decoder_caches
+from transformer_tpu.models.transformer import (
+    transformer_decode_step,
+    transformer_prefill,
+)
+from transformer_tpu.train.decode import (
+    _detokenize_rows,
+    prefill_len_for,
+    sample_token,
+)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _pool_step(params, pool_caches, toks, cfg: ModelConfig):
+    """One decode step for every slot: (N,) tokens -> ((N, V) logits,
+    updated pool caches). vmap over the slot axis: each slot runs a batch-1
+    ``transformer_decode_step`` at its OWN cache index (free slots step too —
+    a fixed-shape program beats per-occupancy recompiles; their writes land
+    at masked positions and are overwritten at admission)."""
+
+    def one(tok, caches):
+        pos = caches[0]["index"]
+        logits, caches = transformer_decode_step(
+            params, tok[None, None], None, None, caches, pos, cfg
+        )
+        return logits[0], caches
+
+    return jax.vmap(one)(toks, pool_caches)
+
+
+@partial(jax.jit, static_argnames=("cfg", "chunk"))
+def _slot_prefill(params, pool_caches, slot, prompt, cfg: ModelConfig, chunk: int):
+    """Prefill a (1, n) prompt into slot ``slot`` (traced — no recompile per
+    slot), resetting its cache index to 0. Returns ((1, V) logits for the
+    next position, updated pool caches).
+
+    NOT donated, unlike ``_pool_step``: an execution-time failure here (e.g.
+    device OOM on a long prompt) is answered as a per-request admission
+    error and the pool keeps serving — donated inputs would already be
+    invalidated, so the next step would dereference deleted buffers and kill
+    every in-flight request. ``_pool_step`` failures are fatal anyway, so
+    the hot per-token path keeps the in-place donation win."""
+    slot_caches = jax.tree.map(lambda x: x[slot], pool_caches)
+    slot_caches = [dict(c, index=jnp.int32(0)) for c in slot_caches]
+    logits, slot_caches = transformer_prefill(
+        params, prompt, None, None, slot_caches, 0, cfg, chunk=chunk
+    )
+    pool_caches = jax.tree.map(
+        lambda pool, s: pool.at[slot].set(s), pool_caches, slot_caches
+    )
+    return logits, pool_caches
+
+
+@partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))
+def _pick_pool(logits, base_keys, positions, temperatures, *, sample, top_k, top_p):
+    """Per-slot next-token picks over the whole pool (fixed shape — one
+    compile per distinct static sampling signature, not per occupancy).
+    Each slot's rng is ``fold_in(base_key, position)`` — the same
+    position-keyed folding ``lm_generate`` uses, so picks match sequential
+    serving bit for bit."""
+
+    def one(row_logits, base_key, position, temperature):
+        key = jax.random.fold_in(base_key, position)
+        return sample_token(
+            row_logits[None], key, sample=sample, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        )[0]
+
+    return jax.vmap(one)(logits, base_keys, positions, temperatures)
+
+
+@partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))
+def _pick_one(logits, base_key, position, temperature, *, sample, top_k, top_p):
+    """Single-row pick for the prefill edge (prompt fully ingested — the
+    prefill's last logits are the first generation tick's logits)."""
+    key = jax.random.fold_in(base_key, position)
+    return sample_token(
+        logits, key, sample=sample, temperature=temperature,
+        top_k=top_k, top_p=top_p,
+    )[0]
+
+
+@dataclasses.dataclass
+class _Active:
+    """Host-side state of one occupied slot."""
+
+    order: int                 # request arrival index (output ordering)
+    ids: list[int]             # BOS-led prompt token ids
+    prompt_len: int
+    pos: int                   # next position to consume (== cache index)
+    cur: int                   # token to feed at the next pool step
+    emitted: list[int]
+    max_new: int
+    key: np.ndarray            # base PRNG key (request seed)
+    sample: bool
+    temperature: float
+    top_k: int
+    top_p: float
+
+
+class SlotPool:
+    """A fixed pool of stacked single-request decoder KV caches."""
+
+    def __init__(self, cfg: ModelConfig, num_slots: int, max_total: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.max_total = max_total
+        per_slot = [
+            init_decoder_caches(cfg, 1, max_total) for _ in range(num_slots)
+        ]
+        # Stack to a leading slot axis: k/v (N, 1, buf, H, D), index (N,).
+        self.caches = jax.tree.map(
+            lambda *xs: jnp.stack(xs), per_slot[0], *per_slot[1:]
+        )
+
+
+class ContinuousScheduler:
+    """Step-level continuous-batching scheduler for decoder-only exports.
+
+    ``submit`` queues LM requests (dicts with ``prompt`` and the optional
+    ``max_new`` / ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` fields
+    the grouped path accepts); ``submit_done`` reserves an output position
+    for an already-answered response (parse/routing errors) so ordering is
+    preserved across both. ``admit``/``step``/``drain_ready`` are the
+    streaming API the serve CLI drives; ``run`` is the batch convenience
+    the tests (and one-shot callers) use.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: ModelConfig,
+        tokenizer,
+        *,
+        num_slots: int = 8,
+        max_total: int | None = None,
+        prefill_chunk: int = 0,
+        default_max_new: int = 64,
+    ):
+        if not cfg.decoder_only:
+            raise ValueError(
+                "continuous batching serves decoder-only LM exports; seq2seq "
+                "and fill-mask requests go through the grouped path"
+            )
+        self.params, self.cfg, self.tok = params, cfg, tokenizer
+        self.prefill_chunk = prefill_chunk
+        self.default_max_new = default_max_new
+        self.max_total = max_total or cfg.max_position + 1
+        self.pool = SlotPool(cfg, num_slots, self.max_total)
+        self.num_slots = num_slots
+        self._free = list(range(num_slots))
+        self._active: dict[int, _Active] = {}
+        self._queue: deque[tuple[int, dict]] = deque()
+        self._done: dict[int, dict] = {}
+        self._next_order = 0
+        self._emit_next = 0
+        self.stats = {"admitted": 0, "steps": 0, "max_active": 0}
+
+    # ---- request intake ---------------------------------------------------
+
+    def submit(self, req: dict) -> int:
+        order = self._next_order
+        self._next_order += 1
+        self._queue.append((order, req))
+        return order
+
+    def submit_done(self, resp: dict) -> int:
+        order = self._next_order
+        self._next_order += 1
+        self._done[order] = resp
+        return order
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue or self._active)
+
+    @property
+    def has_ready(self) -> bool:
+        """True when ``drain_ready`` would release at least one response."""
+        return self._emit_next in self._done
+
+    @property
+    def ready_count(self) -> int:
+        """Completed-but-not-drained responses (includes out-of-order
+        completions waiting behind the arrival-order emit head). The serve
+        loop counts these toward its ingest cap so a flood of instantly
+        answered lines — e.g. all-malformed input — cannot grow the host-side
+        buffer without bound."""
+        return len(self._done)
+
+    @property
+    def backlog(self) -> int:
+        """Submitted-but-not-admitted requests (the serve loop bounds this
+        so stdin backpressure survives — see ``cli/serve.py``)."""
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    # ---- admission --------------------------------------------------------
+
+    def admit(self) -> None:
+        """Fill free slots from the queue (prefill-into-slot). A request
+        that fails validation/encoding answers with its error alone — it
+        never enters the pool, so it cannot poison co-batched requests."""
+        while self._free and self._queue:
+            order, req = self._queue.popleft()
+            try:
+                self._start(order, req)
+            except Exception as e:  # noqa: BLE001 — answers, never kills
+                self._done[order] = {"error": f"{type(e).__name__}: {e}"}
+
+    def _start(self, order: int, req: dict) -> None:
+        prompt = str(req["prompt"])
+        ids = [self.tok.bos_id, *self.tok.encode(prompt)]
+        L = len(ids)
+        if L >= self.cfg.max_position:
+            # Same failure mode (and message shape) as generate().
+            raise ValueError(
+                f"a prompt encodes to {L} tokens but the model's "
+                f"max_position is {self.cfg.max_position}; shorten the prompt"
+            )
+        max_new = int(req.get("max_new", self.default_max_new))
+        max_new = min(max_new, self.cfg.max_position - L)
+        if L + 1 >= self.max_total:
+            raise ValueError(
+                f"a prompt encodes to {L} tokens but the slot budget "
+                f"(serve_max_total) is {self.max_total}; shorten the prompt "
+                "or raise --serve_max_total"
+            )
+        max_new = min(max_new, self.max_total - 1 - L)
+        temperature = float(req.get("temperature", 0.0))
+        sample = temperature > 0.0
+        # Greedy never touches the rng or the truncation params: normalize
+        # them (mirroring _signature's grouped path) so stray values neither
+        # change the answer nor split step()'s pick groups into extra
+        # byte-identical argmax compiles.
+        top_k = int(req.get("top_k", 0)) if sample else 0
+        top_p = float(req.get("top_p", 1.0)) if sample else 1.0
+        seed = int(req.get("seed", 0)) if sample else 0
+        if sample and top_k > self.cfg.target_vocab_size:
+            # lax.top_k would raise INSIDE the jitted pick — validate before
+            # a slot is popped so the bad request answers alone (the grouped
+            # path's per-member retry answers the same line with an error).
+            raise ValueError(
+                f"top_k={top_k} exceeds the vocab size "
+                f"{self.cfg.target_vocab_size}"
+            )
+
+        n = prefill_len_for(L, self.prefill_chunk)
+        slot = self._free.pop()
+        try:
+            logits, self.pool.caches = _slot_prefill(
+                self.params, self.pool.caches, jnp.int32(slot),
+                jnp.asarray([ids[:n]], jnp.int32), self.cfg,
+                self.prefill_chunk,
+            )
+        except Exception:
+            self._free.append(slot)
+            raise
+        st = _Active(
+            order=order, ids=ids, prompt_len=L, pos=n, cur=PAD_ID,
+            emitted=[], max_new=max_new,
+            key=np.asarray(jax.random.PRNGKey(seed)),
+            sample=sample, temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+        self._active[slot] = st
+        self.stats["max_active"] = max(self.stats["max_active"], len(self._active))
+        if n < L:
+            st.cur = ids[n]  # un-prefilled prompt tail feeds token-by-token
+        else:
+            try:
+                tokv = int(
+                    _pick_one(
+                        logits, jnp.asarray(st.key), jnp.int32(n - 1),
+                        jnp.float32(st.temperature),
+                        sample=st.sample, top_k=st.top_k, top_p=st.top_p,
+                    )
+                )
+            except Exception:
+                # The pick failing must not leak the slot: restore the pool
+                # so the error answers this request alone (admit() catches).
+                del self._active[slot]
+                self._free.append(slot)
+                raise
+            self._consume_pick(slot, st, tokv)
+        self.stats["admitted"] += 1
+
+    # ---- stepping ---------------------------------------------------------
+
+    def step(self) -> None:
+        """Advance every occupied slot one token (ONE pooled forward),
+        retire finished slots. No-op when the pool is idle."""
+        if not self._active:
+            return
+        N = self.num_slots
+        toks = np.full((N,), PAD_ID, np.int32)
+        keys = np.zeros((N, *np.shape(jax.random.PRNGKey(0))), np.uint32)
+        positions = np.zeros((N,), np.int32)
+        temps = np.ones((N,), np.float32)
+        for slot, st in self._active.items():
+            toks[slot] = st.cur
+            keys[slot] = st.key
+            positions[slot] = st.pos
+            temps[slot] = st.temperature
+        logits, self.pool.caches = _pool_step(
+            self.params, self.pool.caches, jnp.asarray(toks), self.cfg
+        )
+        groups: dict[tuple, list[int]] = {}
+        for slot, st in self._active.items():
+            groups.setdefault((st.sample, st.top_k, st.top_p), []).append(slot)
+        picks: dict[int, int] = {}
+        for (sample, top_k, top_p), slots in groups.items():
+            out = np.asarray(
+                _pick_pool(
+                    logits, jnp.asarray(keys), jnp.asarray(positions),
+                    jnp.asarray(temps),
+                    sample=sample, top_k=top_k, top_p=top_p,
+                )
+            )
+            for slot in slots:
+                picks[slot] = int(out[slot])
+        for slot, st in list(self._active.items()):
+            st.pos += 1
+            if st.pos < st.prompt_len:
+                st.cur = st.ids[st.pos]  # still consuming the prompt tail
+                continue
+            self._consume_pick(slot, st, picks[slot])
+        self.stats["steps"] += 1
+
+    def _consume_pick(self, slot: int, st: _Active, tokv: int) -> None:
+        """Apply one generated token: retire on EOS or budget exhaustion,
+        else schedule it as the slot's next input. The budget check runs
+        BEFORE the append so max_new=0 answers with an empty continuation
+        (matching generate(max_new=0))."""
+        if tokv == self.tok.eos_id or len(st.emitted) >= st.max_new:
+            self._finish(slot, st)
+            return
+        st.emitted.append(tokv)
+        if len(st.emitted) >= st.max_new:
+            self._finish(slot, st)
+        else:
+            st.cur = tokv
+
+    def _finish(self, slot: int, st: _Active) -> None:
+        text = _detokenize_rows(
+            np.asarray([st.emitted], np.int32) if st.emitted
+            else np.zeros((1, 0), np.int32),
+            1, self.tok,
+        )[0]
+        self._done[st.order] = {"continuation": text}
+        del self._active[slot]
+        self._free.append(slot)
+
+    # ---- output -----------------------------------------------------------
+
+    def drain_ready(self) -> list[dict]:
+        """Responses completed in arrival order (the serve loop's stdout
+        contract): a response is released once every earlier request has
+        answered."""
+        out = []
+        while self._emit_next in self._done:
+            out.append(self._done.pop(self._emit_next))
+            self._emit_next += 1
+        return out
+
+    def run(self, reqs: list[dict]) -> list[dict]:
+        """Drive a fixed request list to completion; returns responses in
+        request order."""
+        for req in reqs:
+            self.submit(req)
+        while self.busy:
+            self.admit()
+            self.step()
+        return self.drain_ready()

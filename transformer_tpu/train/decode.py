@@ -29,6 +29,7 @@ from transformer_tpu.models.encoder import encoder_apply
 from transformer_tpu.models.transformer import (
     transformer_apply,
     transformer_decode_step,
+    transformer_prefill,
 )
 from transformer_tpu.ops.masks import make_padding_mask
 
@@ -39,6 +40,44 @@ def _dummy_rows(ids: jax.Array) -> jax.Array:
     "finished" so a garbage row can never pin the early-exit while_loops
     below at the full ``max_len`` budget."""
     return ~jnp.any(ids != PAD_ID, axis=1, keepdims=True)
+
+
+def sample_token(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    sample: bool = False,
+    temperature: float | jax.Array = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """(B, V) logits -> (B,) int32 next-token ids. ``sample=False`` is greedy
+    argmax; ``sample=True`` draws from softmax(logits/temperature), optionally
+    truncated to the ``top_k`` highest-probability tokens and/or the nucleus
+    of tokens whose cumulative probability reaches ``top_p`` (top-k first,
+    then top-p over the survivors). Shared by ``lm_generate`` and the serving
+    scheduler (``transformer_tpu/serve``) so both paths pick identically."""
+    if not sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / jnp.maximum(
+        jnp.asarray(temperature, jnp.float32), 1e-6
+    )
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # Nucleus: keep the smallest prefix of the probability-sorted
+        # vocab whose mass reaches top_p (the top token always survives:
+        # its exclusive-cumulative mass is 0 < top_p).
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        kept = exclusive < top_p
+        thresh = jnp.min(
+            jnp.where(kept, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_len", "bos_id", "eos_id"))
@@ -55,12 +94,28 @@ def greedy_decode(
     Generated rows start after BOS; positions after a row's EOS are pad.
     For ``cfg.decoder_only`` pass ``src_ids=None`` semantics are not needed —
     seq2seq translation is the reference capability this mirrors.
+
+    Generation starts from a prefilled cache: the BOS "prompt" goes through
+    ``transformer_prefill`` (the same entry point ``lm_generate`` uses for
+    long prompts), and the while_loop continues from the prefill logits.
     """
     batch = src_ids.shape[0]
+    if max_len < 1:
+        return jnp.full((batch, max_len), PAD_ID, jnp.int32)
     enc_mask = make_padding_mask(src_ids)
     enc_out, _ = encoder_apply(params["encoder"], src_ids, enc_mask, cfg)
     caches = init_decoder_caches(cfg, batch, max_len + 1)
     cross_kvs = precompute_cross_kvs(params["decoder"], enc_out, cfg)
+
+    def pick_and_store(t, logits, finished, tokens):
+        """One selection tick: the token for position t+1 from position-t
+        logits, with finished rows frozen to PAD (shared by the hoisted
+        prefill tick and the loop body — identical math by construction)."""
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
+        finished = jnp.logical_or(finished, nxt == eos_id)
+        tokens = jax.lax.dynamic_update_index_in_dim(tokens, nxt[:, 0], t, 1)
+        return nxt, finished, tokens
 
     # while_loop, not scan: the loop EXITS once every row has emitted EOS,
     # so a serve bucket or eval batch pays for its longest actual output,
@@ -75,26 +130,29 @@ def greedy_decode(
         logits, caches = transformer_decode_step(
             params, tok, enc_out, enc_mask, caches, t, cfg, cross_kvs=cross_kvs
         )
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
-        nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
-        finished = jnp.logical_or(finished, nxt == eos_id)
-        tokens = jax.lax.dynamic_update_index_in_dim(tokens, nxt[:, 0], t, 1)
+        nxt, finished, tokens = pick_and_store(t, logits, finished, tokens)
         return (t + 1, nxt, caches, finished, tokens)
 
-    init = (
-        jnp.int32(0),
-        jnp.full((batch, 1), bos_id, jnp.int32),
-        caches,
-        _dummy_rows(src_ids),
+    # Tick 0 hoisted out of the loop as a prefill of the BOS token.
+    logits0, caches = transformer_prefill(
+        params, jnp.full((batch, 1), bos_id, jnp.int32),
+        enc_out, enc_mask, caches, 0, cfg, cross_kvs=cross_kvs,
+    )
+    nxt, finished, tokens = pick_and_store(
+        0, logits0, _dummy_rows(src_ids),
         jnp.full((batch, max_len), PAD_ID, jnp.int32),
     )
+    init = (jnp.int32(1), nxt, caches, finished, tokens)
     *_, tokens = jax.lax.while_loop(cond, body, init)
     return tokens  # (B, max_len)
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new", "eos_id", "sample", "top_k", "top_p"),
+    static_argnames=(
+        "cfg", "max_new", "eos_id", "sample", "top_k", "top_p",
+        "prefill_len", "prefill_chunk",
+    ),
 )
 def lm_generate(
     params,
@@ -107,20 +165,32 @@ def lm_generate(
     temperature: float | jax.Array = 1.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    prefill_len: int = 0,
+    prefill_chunk: int = 0,
 ) -> jax.Array:
     """Causal-LM continuation: (B, P) BOS-led prompt (PAD-right allowed) ->
     (B, max_new) generated ids. The inference path for ``cfg.decoder_only``
     models (the seq2seq entry point is ``greedy_decode``; no reference
     counterpart — the reference is translation-only).
 
-    One compiled program: a single early-exit ``lax.while_loop`` walks
-    prompt + generation positions with per-layer KV caches; during the
-    prompt it feeds the next prompt token (prefill), afterwards the
-    previous sample. ``sample=False``
-    is greedy argmax; ``sample=True`` draws from softmax(logits/temperature),
-    optionally truncated to the ``top_k`` highest-probability tokens and/or
-    the nucleus of tokens whose cumulative probability reaches ``top_p``
-    (both filters applied: top-k first, then top-p over the survivors).
+    One compiled program. ``prefill_len = n > 0`` runs the first ``n``
+    prompt positions through ``transformer_prefill`` — single-pass
+    teacher-forcing forwards (in ``prefill_chunk``-sized chunks), writing
+    all their K/V into the caches in O(n / chunk) matmul-rich calls — and
+    the early-exit ``lax.while_loop`` continues token-by-token from there
+    (remaining ragged prompt tail, then generation). ``prefill_len = 0``
+    walks every position through the loop one token per tick (the legacy
+    shape). CALLER CONTRACT for bit-identical outputs: ``n`` must not
+    exceed the shortest REAL (non-dummy) row's prompt length — prefill
+    teacher-forces ``prompt_ids[:, :n]`` for every row, which is exactly
+    what the loop would have fed only while every row is still inside its
+    prompt (``generate`` computes a safe ``n`` host-side).
+
+    ``sample=False`` is greedy argmax; ``sample=True`` draws via
+    ``sample_token`` (softmax/temperature with optional top-k and top-p
+    nucleus truncation). Sampling parity across prefill lengths holds
+    because each tick's rng is ``fold_in(rng, t)`` — position-keyed, not
+    sequential, so skipped in-prompt picks never shift later draws.
     ``temperature`` is a traced scalar — varying it does NOT recompile; the
     mode flag, ``top_k`` (a shape), and ``top_p`` (gates a sort) are static.
     """
@@ -132,27 +202,28 @@ def lm_generate(
         rng = jax.random.PRNGKey(0)
 
     def pick(logits, key):
-        if not sample:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits.astype(jnp.float32) / jnp.maximum(
-            jnp.asarray(temperature, jnp.float32), 1e-6
+        return sample_token(
+            logits, key, sample=sample, temperature=temperature,
+            top_k=top_k, top_p=top_p,
         )
-        if top_k > 0:
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p < 1.0:
-            # Nucleus: keep the smallest prefix of the probability-sorted
-            # vocab whose mass reaches top_p (the top token always survives:
-            # its exclusive-cumulative mass is 0 < top_p).
-            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            exclusive = jnp.cumsum(probs, axis=-1) - probs
-            kept = exclusive < top_p
-            thresh = jnp.min(
-                jnp.where(kept, sorted_logits, jnp.inf), axis=-1, keepdims=True
-            )
-            logits = jnp.where(logits < thresh, -jnp.inf, logits)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def advance(t, logits, caches, finished, toks):
+        """Selection tick t: choose the token for position t+1 (next prompt
+        token while in-prompt, else the pick), freeze finished rows, store
+        the emission. Shared by the loop body and the hoisted prefill tick."""
+        sampled = pick(logits, jax.random.fold_in(rng, t))[:, None]
+        in_prompt = (t + 1) < prompt_lens  # next position still prompt?
+        nxt_prompt = jax.lax.dynamic_slice_in_dim(
+            prompt_ids, jnp.minimum(t + 1, prompt_len - 1), 1, axis=1
+        )
+        nxt = jnp.where(in_prompt, nxt_prompt, sampled)
+        nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
+        finished = jnp.logical_or(
+            finished, jnp.logical_and(~in_prompt, nxt == eos_id)
+        )
+        emitted = jnp.where(in_prompt, PAD_ID, nxt[:, :1])
+        toks = jax.lax.dynamic_update_index_in_dim(toks, emitted[:, 0], t, 1)
+        return nxt, caches, finished, toks
 
     # while_loop with an early exit (like greedy_decode): once every row
     # has finished generating, remaining ticks are pure PAD — skip them.
@@ -166,27 +237,26 @@ def lm_generate(
         logits, caches = transformer_decode_step(
             params, tok, None, None, caches, t, cfg
         )
-        sampled = pick(logits, jax.random.fold_in(rng, t))[:, None]
-        in_prompt = (t + 1) < prompt_lens  # next position still prompt?
-        nxt_prompt = jax.lax.dynamic_slice_in_dim(
-            prompt_ids, jnp.minimum(t + 1, prompt_len - 1), 1, axis=1
-        )
-        nxt = jnp.where(in_prompt, nxt_prompt, sampled)
-        nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
-        finished = jnp.logical_or(
-            finished, jnp.logical_and(~in_prompt, nxt == eos_id)
-        )
-        emitted = jnp.where(in_prompt, PAD_ID, nxt[:, :1])
-        toks = jax.lax.dynamic_update_index_in_dim(toks, emitted[:, 0], t, 1)
+        nxt, caches, finished, toks = advance(t, logits, caches, finished, toks)
         return (t + 1, nxt, caches, finished, toks)
 
-    init = (
-        jnp.int32(0),
-        prompt_ids[:, :1],
-        caches,
-        _dummy_rows(prompt_ids),  # bucketing dummies start finished
-        jnp.full((batch, total - 1), PAD_ID, jnp.int32),
-    )
+    finished = _dummy_rows(prompt_ids)  # bucketing dummies start finished
+    toks = jnp.full((batch, total - 1), PAD_ID, jnp.int32)
+    # Clamp the prefill below the last loop tick (total - 1) so the hoisted
+    # selection tick always has a buffer slot to write.
+    n = min(prefill_len, prompt_len, total - 1)
+    if n >= 1:
+        logits, caches = transformer_prefill(
+            params, prompt_ids[:, :n], None, None, caches, 0, cfg,
+            chunk=prefill_chunk,
+        )
+        # Replay tick n-1's selection (the prefill's last logits ARE that
+        # tick's logits); ticks 0..n-2 selected nothing — every row was
+        # in-prompt, so their emissions were PAD, already the buffer init.
+        nxt, caches, finished, toks = advance(n - 1, logits, caches, finished, toks)
+        init = (jnp.int32(n), nxt, caches, finished, toks)
+    else:
+        init = (jnp.int32(0), prompt_ids[:, :1], caches, finished, toks)
     *_, toks = jax.lax.while_loop(cond, body, init)
     # toks[:, t] holds the token generated for position t+1; generation
     # starts at each row's prompt_len. Gather each row's max_new tokens.
@@ -227,6 +297,8 @@ def beam_search_decode(
     K = beam_size
     vocab = cfg.target_vocab_size
     NEG = jnp.float32(-1e9)
+    if max_len < 1:
+        return jnp.full((batch, max_len), PAD_ID, jnp.int32)
 
     enc_mask = make_padding_mask(src_ids)
     enc_out, _ = encoder_apply(params["encoder"], src_ids, enc_mask, cfg)
@@ -240,20 +312,10 @@ def beam_search_decode(
         for k, v in precompute_cross_kvs(params["decoder"], enc_out, cfg)
     ]
 
-    # while_loop with an early exit (like greedy_decode): once every beam
-    # of every row is frozen, further ticks only append PAD at zero score —
-    # identical selection, so skip them.
-    def cond(carry):
-        t, _, _, _, finished, _ = carry
-        return jnp.logical_and(t < max_len, ~jnp.all(finished))
-
-    def body(carry):
-        t, tok, caches, scores, finished, tokens_buf = carry
-        # tok: (B*K, 1); scores/finished: (B, K); tokens_buf: (B, K, max_len)
-        logits, caches = transformer_decode_step(
-            params, tok, enc_out_k, enc_mask_k, caches, t, cfg,
-            cross_kvs=cross_kvs,
-        )
+    def select(t, logits, caches, scores, finished, tokens_buf):
+        """Beam-advance tick t: expand position-(t+1) candidates from the
+        position-t logits, keep the top K per row, reorder beam state by
+        parent. Shared by the loop body and the hoisted prefill tick."""
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         logp = logp.reshape(batch, K, vocab)
         # Frozen beams: only PAD continues, at zero cost.
@@ -287,17 +349,39 @@ def beam_search_decode(
         new_finished = jnp.logical_or(finished, nxt_tok == eos_id)
         emit = jnp.where(finished, PAD_ID, nxt_tok)  # pad after freeze
         tok = emit.reshape(batch * K, 1)
-        return (t + 1, tok, caches, flat_scores, new_finished, tokens_buf)
+        return tok, caches, flat_scores, new_finished, tokens_buf
 
-    init = (
-        jnp.int32(0),
-        jnp.full((batch * K, 1), bos_id, jnp.int32),
-        caches,
+    # while_loop with an early exit (like greedy_decode): once every beam
+    # of every row is frozen, further ticks only append PAD at zero score —
+    # identical selection, so skip them.
+    def cond(carry):
+        t, _, _, _, finished, _ = carry
+        return jnp.logical_and(t < max_len, ~jnp.all(finished))
+
+    def body(carry):
+        t, tok, caches, scores, finished, tokens_buf = carry
+        # tok: (B*K, 1); scores/finished: (B, K); tokens_buf: (B, K, max_len)
+        logits, caches = transformer_decode_step(
+            params, tok, enc_out_k, enc_mask_k, caches, t, cfg,
+            cross_kvs=cross_kvs,
+        )
+        out = select(t, logits, caches, scores, finished, tokens_buf)
+        return (t + 1, *out)
+
+    # Tick 0 hoisted out of the loop as a prefill of the BOS token — beams
+    # start generation from the prefilled caches.
+    logits0, caches = transformer_prefill(
+        params, jnp.full((batch * K, 1), bos_id, jnp.int32),
+        enc_out_k, enc_mask_k, caches, 0, cfg, cross_kvs=cross_kvs,
+    )
+    tok, caches, scores, finished, tokens_buf = select(
+        0, logits0, caches,
         jnp.zeros((batch, K), jnp.float32),
         # Bucketing dummies start with every beam frozen.
         jnp.broadcast_to(_dummy_rows(src_ids), (batch, K)),
         jnp.full((batch, K, max_len), PAD_ID, jnp.int32),
     )
+    init = (jnp.int32(1), tok, caches, scores, finished, tokens_buf)
     _, tok, caches, scores, finished, tokens_buf = jax.lax.while_loop(
         cond, body, init
     )
@@ -334,6 +418,31 @@ def _detokenize_rows(out, n: int, tokenizer) -> list[str]:
     return texts
 
 
+def prefill_len_for(prompt_len: int, chunk: int = 0) -> int:
+    """How many prompt positions to run through single-pass prefill for a
+    (shortest-in-batch) real prompt length: ``chunk`` times the largest
+    power of two of whole chunks the prompt covers, else (no chunking, or
+    under one chunk) the largest power of two <= prompt_len. Rounding the
+    CHUNK COUNT to a power of two — not just down to a chunk multiple —
+    keeps the set of distinct static prefill signatures O(log(max_len)),
+    so serving never recompiles per prompt length even with a small
+    ``prefill_chunk`` on a long-context model; the un-prefixed remainder
+    walks through the decode loop one token per tick, which is exact for
+    any length."""
+    if prompt_len < 1:
+        return 0
+    n = 1
+    # chunk <= 0 (including a typo'd negative flag) means "no chunking" —
+    # a negative value must never reach the multiply below.
+    if chunk > 0 and prompt_len >= chunk:
+        while n * 2 <= prompt_len // chunk:
+            n *= 2
+        return n * chunk
+    while n * 2 <= prompt_len:
+        n *= 2
+    return n
+
+
 def generate(
     params,
     cfg: ModelConfig,
@@ -344,13 +453,20 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     seed: int = 0,
+    prefill_chunk: int = 0,
 ) -> list[str]:
     """Text-in/text-out continuation for ``cfg.decoder_only`` models: each
     prompt is BOS-led (matching the LM training windows, ``data.pipeline.
     make_lm_dataset``), generation stops per-row at EOS, output is
     detokenized continuation text. Prompt widths bucket like ``translate``.
     ``temperature`` 0 = greedy; > 0 samples (with optional top-k and/or
-    top-p nucleus truncation)."""
+    top-p nucleus truncation).
+
+    The shared prompt prefix — up to the shortest prompt in the batch,
+    bucketed by ``prefill_len_for`` — is ingested in one pass through
+    ``transformer_prefill`` (``prefill_chunk`` bounds per-call activation
+    memory; 0 = one chunk); outputs are bit-identical to the pure
+    token-by-token loop."""
     if not cfg.decoder_only:
         raise ValueError("generate() is for decoder_only models; use translate()")
     if isinstance(prompts, str):
@@ -367,12 +483,18 @@ def generate(
     max_new = min(max_new, cfg.max_position - longest)
     width = _bucket(longest, cfg.max_position, floor=8)
     ids, n = _pad_batch(encoded, width)
+    # Prefill only the prefix every REAL row agrees is prompt (lm_generate's
+    # caller contract); bucketing dummy rows are all-PAD and teacher-forcing
+    # PAD through prefill matches what the loop feeds them.
+    shortest = min(len(e) for e in encoded)
     out = jax.device_get(
         lm_generate(
             params, jnp.asarray(ids), cfg, max_new, tokenizer.eos_id,
             rng=jax.random.PRNGKey(seed),
             sample=temperature > 0.0, temperature=temperature, top_k=top_k,
             top_p=top_p,
+            prefill_len=prefill_len_for(shortest, prefill_chunk),
+            prefill_chunk=prefill_chunk,
         )
     )
     return _detokenize_rows(out, n, tokenizer)
