@@ -1,0 +1,56 @@
+"""Seeded KNOWN-BAD corpus for the analysis lint rules — one violation per
+rule. Parsed by AST only, never imported/executed; `python -m
+transformer_tpu.analysis rules --paths tests/fixtures/tpa_bad_corpus.py`
+must exit NON-zero (tests/test_analysis.py pins exactly which codes fire).
+The twin file ``tpa_good_corpus.py`` holds the corrected versions and must
+lint clean."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CALL_STATS = {}  # mutable module state
+
+
+@partial(jax.jit, static_argnames=("n",))
+def branch_on_traced(x, n):
+    if x > 0:  # TPA001: x is traced; this either raises or bakes one branch
+        return x * n
+    return x
+
+
+@jax.jit
+def numpy_on_tracer(x):
+    total = np.sum(x)  # TPA002: numpy materializes the tracer
+    return x / total
+
+
+@jax.jit
+def reads_mutable_state(x):
+    scale = _CALL_STATS["scale"]  # TPA003: captured at trace time, silently stale
+    return x * scale
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def stale_static_name(x, cfg, length):  # TPA004: 'max_len' is not a parameter
+    return x[:length]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_buffer(buf, delta):
+    return buf + delta
+
+
+def donated_reuse(buf, delta):
+    new = update_buffer(buf, delta)
+    return buf + new  # TPA005: buf was donated — its buffer is invalidated
+
+
+def swallow_everything(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:  # TPA006: swallows unrelated failures in library code
+        return None

@@ -1,0 +1,68 @@
+"""KNOWN-GOOD twin of ``tpa_bad_corpus.py``: the same six shapes written
+correctly, plus the laundering/suppression idioms the rules must NOT flag.
+`python -m transformer_tpu.analysis rules --paths
+tests/fixtures/tpa_good_corpus.py` must exit 0."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SCALE = 2.0  # immutable module constant: fine to close over
+
+
+@partial(jax.jit, static_argnames=("n",))
+def branch_on_static(x, n):
+    if n > 0:  # static argument: concrete at trace time
+        return x * n
+    if x.shape[0] > 4:  # shape metadata is concrete under trace
+        return x[:4]
+    return jnp.where(x > 0, x, -x)  # traced condition, traced select
+
+
+@jax.jit
+def jnp_on_tracer(x, mask=None):
+    if mask is None:  # identity test against None is concrete
+        total = jnp.sum(x)
+    else:
+        total = jnp.sum(x * mask)
+    return x / total
+
+
+@jax.jit
+def reads_constant_state(x):
+    rows = np.arange(len(x))  # numpy on concrete (len launders the tracer)
+    return x * _SCALE + jnp.asarray(rows)
+
+
+@partial(jax.jit, static_argnames=("length",))
+def fresh_static_name(x, length):
+    return x[:length]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_buffer(buf, delta):
+    return buf + delta
+
+
+def donated_rebound(buf, delta):
+    buf = update_buffer(buf, delta)  # rebind: the name now owns the result
+    return buf + 1
+
+
+def narrow_handler(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except (OSError, UnicodeDecodeError):  # the failures open/read can raise
+        return None
+
+
+def cleanup_handler(path, pool):
+    slot = pool.pop()
+    try:
+        return open(path)
+    except Exception:  # broad but re-raising: a cleanup pass-through
+        pool.append(slot)
+        raise

@@ -1,0 +1,41 @@
+"""transformer_tpu.analysis — JAX-aware static analysis for this codebase.
+
+Three passes, one CLI (``python -m transformer_tpu.analysis``):
+
+- :mod:`.rules` — AST lint rules (TPA001–TPA006) for the silent-bug classes
+  jit-heavy code grows: traced-value branches, numpy-on-tracer, mutable
+  closure state, stale ``static_argnames``, donated-buffer reuse, broad
+  exception swallowing in library modules. Inline ``# tpa: disable=`` and a
+  checked-in baseline (``analysis/baseline.json``) handle grandfathering.
+- :mod:`.contracts` — abstract shape/dtype contract checks over the public
+  entry points via ``jax.eval_shape``/``jax.make_jaxpr``: f32 softmax,
+  prefill/step cache-layout parity across all cache variants, mask
+  broadcastability, residual-dtype stability, decode output shapes,
+  optimizer dtype preservation. No device execution.
+- :mod:`.retrace` — compile-count sentinel (``_cache_size`` accounting)
+  failing when the steady-state decode/train hot paths retrace beyond a
+  declared budget, plus ``jax.checking_leaks`` wiring.
+
+Everything here is import-light: importing the package costs nothing until a
+pass actually runs (the lint rules never import the modules they analyze).
+"""
+
+from transformer_tpu.analysis.contracts import ContractResult, run_contracts
+from transformer_tpu.analysis.retrace import RetraceSentinel, leak_checking
+from transformer_tpu.analysis.rules import (
+    RULES,
+    Finding,
+    RulesReport,
+    run_rules,
+)
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "RulesReport",
+    "run_rules",
+    "ContractResult",
+    "run_contracts",
+    "RetraceSentinel",
+    "leak_checking",
+]

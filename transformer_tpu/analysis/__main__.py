@@ -1,0 +1,149 @@
+"""``python -m transformer_tpu.analysis`` — the static-analysis CLI.
+
+Subcommands (all CPU-safe; exit code 0 = clean, 1 = findings/violations):
+
+- ``rules [--paths P ...] [--baseline FILE] [--update-baseline]`` — AST lint
+  rules TPA001–TPA006 over the package (or explicit paths).
+- ``contracts [--matrix fast|full]`` — abstract shape/dtype contract checks
+  via ``jax.eval_shape``/``jax.make_jaxpr`` (no device execution).
+- ``retrace [--steps N]`` — compile-count sentinel over the steady-state
+  decode and train hot paths (0 new programs allowed after warmup).
+
+``--format=json`` emits machine-readable output on every subcommand so
+rounds can diff finding counts like a bench (``bench.py`` row style).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _emit(payload: dict, text: str, fmt: str) -> None:
+    print(json.dumps(payload, indent=2, sort_keys=True) if fmt == "json" else text)
+
+
+def _cmd_rules(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.rules import (
+        default_baseline_path,
+        run_rules,
+        write_baseline,
+    )
+
+    baseline = args.baseline
+    if baseline is None and not args.paths:
+        baseline = default_baseline_path()
+    report = run_rules(paths=args.paths or None, baseline_path=baseline)
+    if args.update_baseline:
+        path = baseline or default_baseline_path()
+        write_baseline(report, path)
+        print(
+            f"baselined {len(report.findings) + len(report.baselined)} "
+            f"finding(s) -> {path}"
+        )
+        return 0
+    lines = [str(f) for f in report.findings]
+    lines.append(
+        f"{len(report.findings)} finding(s) across {report.files_checked} "
+        f"file(s) ({len(report.baselined)} baselined)"
+    )
+    _emit(report.to_dict(), "\n".join(lines), args.format)
+    return 1 if report.findings else 0
+
+
+def _cmd_contracts(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.configs import describe, matrix
+    from transformer_tpu.analysis.contracts import run_contracts, summarize
+
+    results = run_contracts(args.matrix)
+    payload = {
+        "matrix": args.matrix,
+        "configs": {
+            name: describe(cfg) for name, cfg in matrix(args.matrix).items()
+        },
+        "passed": sum(r.ok for r in results),
+        "total": len(results),
+        "results": [r.to_dict() for r in results],
+    }
+    _emit(payload, summarize(results), args.format)
+    return 0 if all(r.ok for r in results) else 1
+
+
+def _cmd_retrace(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.retrace import (
+        decode_retrace_report,
+        train_retrace_report,
+    )
+
+    deltas = decode_retrace_report(steps=args.steps) + train_retrace_report(
+        steps=args.steps
+    )
+    ok = all(d.within_budget for d in deltas)
+    text = "\n".join(
+        f"{'PASS' if d.within_budget else 'FAIL'} {d.name}: "
+        f"{d.compiles} recompile(s) over {args.steps} steady-state steps "
+        f"(budget {d.budget})"
+        for d in deltas
+    )
+    payload = {
+        "steps": args.steps,
+        "ok": ok,
+        "watches": [d.to_dict() for d in deltas],
+    }
+    _emit(payload, text, args.format)
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m transformer_tpu.analysis",
+        description="JAX-aware static analysis: lint rules, abstract "
+        "shape/dtype contracts, retrace sentinel",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_rules = sub.add_parser("rules", help="AST lint rules (TPA001-TPA006)")
+    p_rules.add_argument(
+        "--paths", nargs="*", default=None,
+        help="files/dirs to lint (default: the transformer_tpu package)",
+    )
+    p_rules.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: analysis/baseline.json for package lints)",
+    )
+    p_rules.add_argument(
+        "--update-baseline", action="store_true",
+        help="grandfather every current finding into the baseline file",
+    )
+
+    p_contracts = sub.add_parser(
+        "contracts", help="abstract shape/dtype contract checks (eval_shape)"
+    )
+    p_contracts.add_argument(
+        "--matrix", choices=("fast", "full"), default="fast",
+        help="config matrix: fast = tier-1 set, full = architectural spread",
+    )
+
+    p_retrace = sub.add_parser(
+        "retrace", help="compile-count sentinel over decode/train hot paths"
+    )
+    p_retrace.add_argument(
+        "--steps", type=int, default=3,
+        help="steady-state iterations after warmup (default 3)",
+    )
+
+    for p in (p_rules, p_contracts, p_retrace):
+        p.add_argument(
+            "--format", choices=("text", "json"), default="text",
+            help="output format (json is diff-able across rounds)",
+        )
+
+    args = parser.parse_args(argv)
+    return {"rules": _cmd_rules, "contracts": _cmd_contracts, "retrace": _cmd_retrace}[
+        args.cmd
+    ](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

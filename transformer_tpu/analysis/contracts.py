@@ -1,0 +1,378 @@
+"""Abstract shape/dtype contract checking — no device execution.
+
+Every check traces public entry points with ``jax.eval_shape`` or
+``jax.make_jaxpr`` over abstract ``ShapeDtypeStruct`` inputs (even the
+parameter pytree is abstract: ``transformer_init`` is itself eval_shape'd),
+so the whole suite is CPU-safe, allocation-free, and fast enough for tier-1.
+This is the Mesh-TensorFlow lesson (PAPERS.md) applied to this repo: the
+invariants the code PROMISES in its docstrings become machine-checked
+contracts that fail at trace time, rounds before a TPU would have noticed.
+
+Contracts:
+
+- **cache_parity** — prefill and incremental decode must produce caches
+  with identical pytree structure, shapes, AND dtypes for every cache
+  variant (plain bf16, int8+scales, rolling window, GQA). A drift here is
+  the classic silent serving bug: the slot pool admits via prefill but
+  steps incrementally, so a mismatch poisons every request after the first.
+- **softmax_f32** — ``dot_product_attention`` promises its softmax runs in
+  fp32 even under bf16 compute (``ops/attention.py``); checked by walking
+  the jaxpr of the forward for ``exp`` equations and asserting their
+  operands are f32.
+- **residual_dtype** — the residual stream must stay in
+  ``cfg.compute_dtype`` end to end (no silent bf16→f32 promotion that would
+  double HBM traffic and MXU pressure).
+- **mask_broadcast** — padding/causal/cache-prefix masks must broadcast
+  against (B, H, S_q, S_k) attention logits.
+- **decode_shapes** — greedy/beam/LM decode return (B, max_len)/(B,
+  max_new) int32 ids.
+- **train_step_dtypes** — one abstract optimizer step preserves every
+  parameter's dtype (param_dtype, not compute dtype) and advances ``step``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transformer_tpu.analysis.configs import TINY_TRAIN, matrix
+from transformer_tpu.config import ModelConfig
+
+_KEY = jax.ShapeDtypeStruct((2,), np.uint32)  # abstract PRNGKey
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractResult:
+    contract: str
+    config: str
+    ok: bool
+    detail: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        mark = "PASS" if self.ok else "FAIL"
+        return f"{mark} {self.contract}[{self.config}] {self.detail}"
+
+
+def _ids(batch: int, length: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct((batch, length), np.int32)
+
+
+def abstract_params(cfg: ModelConfig):
+    """The parameter pytree as ShapeDtypeStructs — nothing is allocated."""
+    from transformer_tpu.models.transformer import transformer_init
+
+    return jax.eval_shape(lambda k: transformer_init(k, cfg), _KEY)
+
+
+def _tree_spec(tree) -> list[tuple[str, tuple, str]]:
+    """Canonical (path, shape, dtype) list for structure+layout comparison."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [
+        (jax.tree_util.keystr(path), tuple(leaf.shape), str(leaf.dtype))
+        for path, leaf in flat
+    ]
+
+
+# --------------------------------------------------------------------------
+# individual contracts (each returns a detail string or raises AssertionError)
+
+
+def check_cache_parity(cfg: ModelConfig, batch: int = 2, n: int = 4) -> str:
+    """Prefill-built caches and step-built caches must be indistinguishable
+    in structure, shape, and dtype (the serving scheduler mixes the two
+    paths over one slot pool)."""
+    from transformer_tpu.models.decoder import (
+        init_decoder_caches,
+        precompute_cross_kvs,
+    )
+    from transformer_tpu.models.encoder import encoder_apply
+    from transformer_tpu.models.transformer import (
+        transformer_decode_step,
+        transformer_prefill,
+    )
+    from transformer_tpu.ops.masks import make_padding_mask
+
+    params = abstract_params(cfg)
+    total = 16
+
+    def encoder_state(params, tokens):
+        # Seq2seq decode attends a (static) encoder output through
+        # precomputed cross K/Vs — the same wiring greedy_decode uses.
+        if cfg.decoder_only:
+            return None, None, None
+        enc_mask = make_padding_mask(tokens)
+        enc_out, _ = encoder_apply(params["encoder"], tokens, enc_mask, cfg)
+        return enc_out, enc_mask, precompute_cross_kvs(
+            params["decoder"], enc_out, cfg
+        )
+
+    def prefill_path(params, tokens):
+        enc_out, enc_mask, cross_kvs = encoder_state(params, tokens)
+        caches = init_decoder_caches(cfg, batch, total)
+        _, caches = transformer_prefill(
+            params, tokens, enc_out, enc_mask, caches, 0, cfg,
+            cross_kvs=cross_kvs,
+        )
+        return caches
+
+    def step_path(params, tokens):
+        enc_out, enc_mask, cross_kvs = encoder_state(params, tokens)
+        caches = init_decoder_caches(cfg, batch, total)
+        for i in range(n):
+            _, caches = transformer_decode_step(
+                params, tokens[:, i : i + 1], enc_out, enc_mask, caches, i,
+                cfg, cross_kvs=cross_kvs,
+            )
+        return caches
+
+    tokens = _ids(batch, n)
+    via_prefill = jax.eval_shape(prefill_path, params, tokens)
+    via_steps = jax.eval_shape(step_path, params, tokens)
+    a, b = _tree_spec(via_prefill), _tree_spec(via_steps)
+    assert a == b, (
+        "prefill and incremental step disagree on cache layout/dtype:\n"
+        f"  prefill: {a}\n  steps:   {b}"
+    )
+    # The variant-specific storage promises, stated explicitly:
+    leaf = {path: (shape, dtype) for path, shape, dtype in a}
+    k_path = next(p for p in leaf if p.endswith("['k']"))
+    if cfg.kv_cache_int8:
+        assert leaf[k_path][1] == "int8", f"int8 cache stores k as {leaf[k_path][1]}"
+        scale_path = next(p for p in leaf if p.endswith("['k_scale']"))
+        assert leaf[scale_path][1] == "float32", "int8 scales must be fp32"
+    else:
+        assert leaf[k_path][1] == str(cfg.compute_dtype), (
+            f"cache k dtype {leaf[k_path][1]} != compute dtype {cfg.compute_dtype}"
+        )
+    buf_len = leaf[k_path][0][1]
+    if cfg.attention_window:
+        expected = min(cfg.attention_window, total)
+        assert buf_len == expected, (
+            f"rolling cache buffer is {buf_len} slots, want {expected}"
+        )
+    else:
+        assert buf_len == total, f"cache buffer {buf_len} != max_len {total}"
+    kv_heads = leaf[k_path][0][2]
+    assert kv_heads == cfg.kv_heads, (
+        f"cache carries {kv_heads} kv heads, config says {cfg.kv_heads}"
+    )
+    return f"{len(a)} cache leaves identical across prefill/step"
+
+
+def _walk_eqns(jaxpr) -> Iterable:
+    """Every equation, recursing through pjit/scan/while/cond sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _as_jaxprs(v):
+                yield from _walk_eqns(sub)
+
+
+def _as_jaxprs(v) -> Iterable:
+    if isinstance(v, jax.core.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, jax.core.Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _as_jaxprs(item)
+
+
+def check_softmax_f32(cfg: ModelConfig, batch: int = 2, length: int = 8) -> str:
+    """Every ``exp`` in the forward jaxpr (softmax is the only exp in a
+    relu/bf16 config) must consume f32 — the documented f32-softmax
+    contract of ``dot_product_attention``."""
+    from transformer_tpu.models.transformer import transformer_apply
+
+    params = abstract_params(cfg)
+    inp = None if (cfg.decoder_only or cfg.encoder_only) else _ids(batch, length)
+    jaxpr = jax.make_jaxpr(
+        lambda p, i, t: transformer_apply(p, i, t, cfg)
+    )(params, inp, _ids(batch, length))
+    exps = [e for e in _walk_eqns(jaxpr.jaxpr) if e.primitive.name == "exp"]
+    assert exps, "no exp equation found — did softmax disappear from the forward?"
+    bad = [
+        str(e.invars[0].aval.dtype)
+        for e in exps
+        if e.invars[0].aval.dtype != jnp.float32
+    ]
+    assert not bad, (
+        f"{len(bad)}/{len(exps)} exp ops run outside f32 ({sorted(set(bad))}) "
+        f"under compute dtype {cfg.dtype} — the f32-softmax contract is broken"
+    )
+    return f"all {len(exps)} exp ops in f32"
+
+
+def check_residual_dtype(cfg: ModelConfig, batch: int = 2, length: int = 8) -> str:
+    """The pre-projection residual stream stays in the compute dtype — a
+    silent promotion to f32 would double decode HBM traffic."""
+    from transformer_tpu.models.transformer import transformer_hidden_apply
+
+    params = abstract_params(cfg)
+    inp = None if (cfg.decoder_only or cfg.encoder_only) else _ids(batch, length)
+    hidden, _ = jax.eval_shape(
+        lambda p, i, t: transformer_hidden_apply(p, i, t, cfg),
+        params, inp, _ids(batch, length),
+    )
+    assert hidden.dtype == cfg.compute_dtype, (
+        f"residual stream is {hidden.dtype}, compute dtype is "
+        f"{cfg.compute_dtype} — silent promotion"
+    )
+    assert hidden.shape == (batch, length, cfg.d_model)
+    return f"hidden (B,S,{cfg.d_model}) stays {hidden.dtype}"
+
+
+def check_mask_broadcast(cfg: ModelConfig, batch: int = 2, length: int = 8) -> str:
+    """All mask builders must broadcast against (B, H, S_q, S_k) logits."""
+    from transformer_tpu.ops.masks import (
+        make_cache_prefix_mask,
+        make_causal_mask,
+        make_padding_mask,
+    )
+
+    logits_shape = (batch, cfg.num_heads, length, length)
+
+    def build(ids):
+        return (
+            make_padding_mask(ids),
+            make_causal_mask(length, window=cfg.attention_window),
+            make_cache_prefix_mask(jnp.int32(0), length, length),
+        )
+
+    pad, causal, prefix = jax.eval_shape(build, _ids(batch, length))
+    for name, m in (("padding", pad), ("causal", causal), ("prefix", prefix)):
+        assert m.dtype == jnp.bool_, f"{name} mask dtype {m.dtype} != bool"
+        try:
+            np.broadcast_shapes(m.shape, logits_shape)
+        except ValueError as e:
+            raise AssertionError(
+                f"{name} mask {m.shape} does not broadcast to logits "
+                f"{logits_shape}: {e}"
+            ) from None
+    return f"padding/causal/prefix masks broadcast to {logits_shape}"
+
+
+def check_decode_shapes(cfg: ModelConfig, batch: int = 2) -> str:
+    """Decode entry points return (B, max_len)/(B, max_new) int32 ids."""
+    params = abstract_params(cfg)
+    max_len = 6
+    if cfg.decoder_only:
+        from transformer_tpu.train.decode import lm_generate
+
+        out = jax.eval_shape(
+            lambda p, ids: lm_generate.__wrapped__(
+                p, ids, cfg, max_len, eos_id=2, prefill_len=4
+            ),
+            params, _ids(batch, 5),
+        )
+        assert out.shape == (batch, max_len) and out.dtype == jnp.int32, (
+            f"lm_generate -> {out.shape} {out.dtype}, want ({batch}, {max_len}) int32"
+        )
+        return f"lm_generate -> ({batch}, {max_len}) int32"
+    from transformer_tpu.train.decode import beam_search_decode, greedy_decode
+
+    greedy = jax.eval_shape(
+        lambda p, src: greedy_decode.__wrapped__(p, src, cfg, max_len, 1, 2),
+        params, _ids(batch, 5),
+    )
+    beam = jax.eval_shape(
+        lambda p, src: beam_search_decode.__wrapped__(
+            p, src, cfg, max_len, 1, 2, beam_size=2
+        ),
+        params, _ids(batch, 5),
+    )
+    for name, out in (("greedy_decode", greedy), ("beam_search_decode", beam)):
+        assert out.shape == (batch, max_len) and out.dtype == jnp.int32, (
+            f"{name} -> {out.shape} {out.dtype}, want ({batch}, {max_len}) int32"
+        )
+    return f"greedy+beam -> ({batch}, {max_len}) int32"
+
+
+def check_train_step_dtypes(cfg: ModelConfig) -> str:
+    """One abstract optimizer step: parameter dtypes preserved exactly
+    (param_dtype — the optimizer must not let compute-dtype activations
+    bleed into the master weights), metrics scalar f32, step advanced."""
+    from transformer_tpu.train.state import TrainState, make_optimizer
+    from transformer_tpu.train.trainer import make_train_step
+
+    train_cfg = TINY_TRAIN
+    if cfg.encoder_only:
+        train_cfg = dataclasses.replace(train_cfg, objective="mlm")
+    step_fn = make_train_step(cfg, train_cfg)
+    params = abstract_params(cfg)
+
+    def init_and_step(params, src, tgt, rng):
+        tx = make_optimizer(cfg, train_cfg)
+        state = TrainState(
+            step=jnp.int32(0), params=params, opt_state=tx.init(params)
+        )
+        return step_fn(state, src, tgt, rng)
+
+    B, L = train_cfg.batch_size, train_cfg.sequence_length
+    new_state, metrics = jax.eval_shape(
+        init_and_step, params, _ids(B, L), _ids(B, L), _KEY
+    )
+    before = _tree_spec(params)
+    after = _tree_spec(new_state.params)
+    assert before == after, (
+        "optimizer step changed parameter shapes/dtypes:\n"
+        f"  before: {before}\n  after:  {after}"
+    )
+    assert new_state.step.dtype == jnp.int32
+    loss = metrics["loss"]
+    assert loss.shape == () and loss.dtype == jnp.float32, (
+        f"loss metric is {loss.shape} {loss.dtype}, want scalar f32"
+    )
+    return f"{len(after)} param leaves dtype-stable through the optimizer step"
+
+
+# --------------------------------------------------------------------------
+# driver
+
+_CONTRACTS: list[tuple[str, Callable[[ModelConfig], str], Callable[[ModelConfig], bool]]] = [
+    ("cache_parity", check_cache_parity, lambda c: not c.encoder_only),
+    ("softmax_f32", check_softmax_f32, lambda c: True),
+    ("residual_dtype", check_residual_dtype, lambda c: True),
+    ("mask_broadcast", check_mask_broadcast, lambda c: True),
+    ("decode_shapes", check_decode_shapes, lambda c: not c.encoder_only),
+    ("train_step_dtypes", check_train_step_dtypes, lambda c: True),
+]
+
+
+def run_contracts(matrix_name: str = "fast") -> list[ContractResult]:
+    """Trace every applicable (contract, config) pair; failures are captured
+    as results, never raised (the CLI exits non-zero when any ``ok`` is
+    False)."""
+    results: list[ContractResult] = []
+    for cfg_name, cfg in matrix(matrix_name).items():
+        for contract_name, fn, applies in _CONTRACTS:
+            if not applies(cfg):
+                continue
+            try:
+                detail = fn(cfg)
+                ok = True
+            except AssertionError as e:
+                detail, ok = str(e), False
+            results.append(
+                ContractResult(
+                    contract=contract_name, config=cfg_name, ok=ok, detail=detail
+                )
+            )
+    return results
+
+
+def summarize(results: list[ContractResult]) -> str:
+    failed = [r for r in results if not r.ok]
+    lines = [str(r) for r in (failed or results)]
+    lines.append(
+        f"{len(results) - len(failed)}/{len(results)} contracts hold"
+        + ("" if not failed else f" — {len(failed)} FAILED")
+    )
+    return "\n".join(lines)

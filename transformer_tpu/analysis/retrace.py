@@ -1,0 +1,210 @@
+"""Retrace sentinel: catch recompilation regressions before a TPU does.
+
+A jitted hot path that silently retraces — a config knob that stopped being
+hashable, a shape that stopped bucketing, a weak-typed scalar flipping per
+call — costs seconds of XLA compile per occurrence and shows up only as
+mysterious step-time jitter. With the bench relay often down (ROADMAP), a
+retrace regression could ship unmeasured for rounds; this module turns "the
+steady-state decode path compiles exactly N programs" into an assertable
+budget.
+
+Mechanics: every ``jax.jit`` callable exposes ``_cache_size()`` — the number
+of compiled executables its cache holds. :class:`RetraceSentinel` snapshots
+the watched functions' cache sizes, the caller drives the hot path, and
+``check()`` fails if any function compiled more NEW programs than its
+declared budget (0 for a steady-state path). This is jit-cache accounting,
+not wall-clock sampling, so it is exact and CPU-safe.
+
+``leak_checking()`` wires ``jax.checking_leaks`` around a block: tracer
+leaks (the cousin failure mode — a traced value smuggled out through module
+state) raise at the source instead of exploding later.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import jax
+
+
+def _cache_size(fn: Any) -> int:
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        raise ValueError(
+            f"{fn!r} exposes no _cache_size — pass the jax.jit-wrapped "
+            "callable itself (not the underlying Python function)"
+        )
+    return int(probe())
+
+
+@dataclasses.dataclass
+class WatchDelta:
+    name: str
+    budget: int
+    before: int
+    after: int
+
+    @property
+    def compiles(self) -> int:
+        return self.after - self.before
+
+    @property
+    def within_budget(self) -> bool:
+        return self.compiles <= self.budget
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "budget": self.budget,
+            "compiles": self.compiles,
+            "cache_before": self.before,
+            "cache_after": self.after,
+            "ok": self.within_budget,
+        }
+
+
+class RetraceSentinel:
+    """Budgeted compile-count accounting over a set of jitted functions.
+
+    >>> sentinel = RetraceSentinel()
+    >>> sentinel.watch("decode_step", _pool_step, budget=0)
+    >>> sentinel.snapshot()          # after warmup
+    >>> ...drive the steady-state hot path...
+    >>> sentinel.assert_within_budget()
+    """
+
+    def __init__(self) -> None:
+        self._fns: dict[str, tuple[Any, int]] = {}
+        self._before: dict[str, int] = {}
+
+    def watch(self, name: str, fn: Any, budget: int = 0) -> None:
+        _cache_size(fn)  # validate now, not at snapshot time
+        self._fns[name] = (fn, budget)
+
+    def snapshot(self) -> dict[str, int]:
+        self._before = {
+            name: _cache_size(fn) for name, (fn, _) in self._fns.items()
+        }
+        return dict(self._before)
+
+    def deltas(self) -> list[WatchDelta]:
+        if not self._fns:
+            return []
+        if not self._before:
+            raise RuntimeError("snapshot() was never taken — nothing to diff")
+        return [
+            WatchDelta(
+                name=name,
+                budget=budget,
+                before=self._before[name],
+                after=_cache_size(fn),
+            )
+            for name, (fn, budget) in self._fns.items()
+        ]
+
+    def violations(self) -> list[WatchDelta]:
+        return [d for d in self.deltas() if not d.within_budget]
+
+    def assert_within_budget(self) -> None:
+        bad = self.violations()
+        if bad:
+            raise AssertionError(
+                "retrace budget exceeded: "
+                + "; ".join(
+                    f"{d.name} compiled {d.compiles} new program(s), "
+                    f"budget {d.budget}"
+                    for d in bad
+                )
+            )
+
+
+@contextlib.contextmanager
+def leak_checking() -> Iterator[None]:
+    """``jax.checking_leaks`` as a composable context: tracer leaks raise
+    where they escape. Trace-heavy (re-traces watched functions), so this is
+    a debugging/CI tool, not a production wrapper."""
+    with jax.checking_leaks():
+        yield
+
+
+# --------------------------------------------------------------------------
+# canned steady-state scenarios (CLI `retrace` + tests)
+
+
+def _tiny_lm_setup():
+    from transformer_tpu.analysis.configs import FAST_MATRIX
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.models.transformer import transformer_init
+
+    cfg = FAST_MATRIX["lm_bf16"]
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    tok = SubwordTokenizer.build_from_corpus(
+        ["the quick brown fox jumps over the lazy dog"] * 4,
+        target_vocab_size=cfg.input_vocab_size - 2,
+    )
+    return cfg, params, tok
+
+
+def decode_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state serving: warm the slot-pool scheduler up on one request,
+    snapshot, then serve ``steps`` more same-shaped requests. The hot paths
+    (``_pool_step`` = decode step, ``_slot_prefill``, ``_pick_pool``) must
+    compile ZERO new programs — admission bucketing (``prefill_len_for``)
+    and the fixed-shape pool exist precisely to guarantee this."""
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg, params, tok = _tiny_lm_setup()
+
+    def serve(reqs):
+        s = ContinuousScheduler(
+            params, cfg, tok, num_slots=2, max_total=32, default_max_new=4
+        )
+        return s.run(reqs)
+
+    serve([{"prompt": "the quick brown fox"}])  # warmup compile
+    sentinel = RetraceSentinel()
+    sentinel.watch("decode_step(_pool_step)", sched._pool_step, budget=0)
+    sentinel.watch("_slot_prefill", sched._slot_prefill, budget=0)
+    sentinel.watch("pick(_pick_pool)", sched._pick_pool, budget=0)
+    sentinel.snapshot()
+    for _ in range(steps):
+        out = serve([{"prompt": "the quick brown fox"}])
+        assert "continuation" in out[0], out
+    return sentinel.deltas()
+
+
+def train_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state training: one warmup step compiles; ``steps`` more
+    same-shaped steps must not."""
+    import numpy as np
+
+    from transformer_tpu.analysis.configs import TINY_TRAIN
+    from transformer_tpu.train.state import TrainState, make_optimizer
+    from transformer_tpu.train.trainer import make_train_step
+
+    cfg, params, _ = _tiny_lm_setup()
+    train_cfg = TINY_TRAIN
+    tx = make_optimizer(cfg, train_cfg)
+    state = TrainState(
+        step=jax.numpy.int32(0), params=params, opt_state=tx.init(params)
+    )
+    step = jax.jit(make_train_step(cfg, train_cfg, tx=tx))
+    B, L = train_cfg.batch_size, train_cfg.sequence_length
+    rng = np.random.default_rng(0)
+
+    def batch():
+        ids = rng.integers(1, cfg.input_vocab_size, size=(B, L)).astype(np.int32)
+        return ids, ids
+
+    src, tgt = batch()
+    state, _ = step(state, src, tgt, jax.random.PRNGKey(0))  # warmup
+    sentinel = RetraceSentinel()
+    sentinel.watch("train_step", step, budget=0)
+    sentinel.snapshot()
+    for i in range(steps):
+        src, tgt = batch()
+        state, _ = step(state, src, tgt, jax.random.PRNGKey(i))
+    return sentinel.deltas()

@@ -1,0 +1,926 @@
+"""JAX-aware AST lint rules (TPA001–TPA006).
+
+Static analysis over the package source for the silent-bug classes that
+jit-heavy code grows (SURVEY.md territory; Mesh-TensorFlow's thesis in
+PAPERS.md — compile-time checking is what keeps a supercomputer-scale stack
+maintainable). Every rule reports a :class:`Finding` with a stable
+fingerprint, honours inline ``# tpa: disable=CODE`` suppressions, and can be
+grandfathered through a checked-in baseline file (``analysis/baseline.json``).
+
+Rule catalogue (docs/ANALYSIS.md has the long-form version):
+
+- **TPA001** — Python ``if``/``while`` whose condition involves a traced
+  value inside a jitted function. Under trace these either raise a
+  ConcretizationTypeError or, worse, bake one branch into the compiled
+  program. Conditions on static arguments, on shape/dtype/ndim metadata, and
+  ``x is None`` / ``x is not None`` identity tests are concrete and allowed.
+- **TPA002** — a ``numpy`` function applied to a traced value inside a
+  jitted function: NumPy either materializes the tracer (host sync /
+  TracerArrayConversionError) or silently computes at trace time.
+- **TPA003** — a jitted function reading module-level *mutable* state
+  (module dicts/lists, ``global``-rebound names): jit captures the value at
+  trace time, so later mutation is silently ignored (or forces retraces).
+- **TPA004** — ``static_argnames`` naming a parameter that does not exist in
+  the decorated signature (jax only validates lazily, and only sometimes),
+  or ``static_argnums``/``donate_argnums`` out of the positional range.
+- **TPA005** — reuse of a donated argument after the donating call: donated
+  buffers are invalidated by XLA; the next dereference dies at runtime with
+  a buffer-deleted error only on the devices that donated.
+- **TPA006** — broad ``except Exception:`` (or bare ``except:``) in a
+  LIBRARY module (anything outside ``cli/`` and ``__main__`` entry points).
+  Handlers that unconditionally re-raise (cleanup handlers ending in bare
+  ``raise``) are structural pass-throughs and exempt.
+
+The taint analysis is deliberately conservative-but-simple: values derived
+from non-static parameters of a jitted function are traced; ``.shape`` /
+``.dtype`` / ``.ndim`` / ``.size`` reads and ``len()`` launder taint (those
+are concrete under trace). False negatives are acceptable; false positives
+on the shipped tree are not — ``python -m transformer_tpu.analysis rules``
+must exit 0 (tests/test_analysis.py pins both directions per rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+RULES: dict[str, str] = {
+    "TPA001": "Python if/while on a traced value inside a jitted function",
+    "TPA002": "numpy op applied to a traced value inside a jitted function",
+    "TPA003": "jitted function closes over mutable module state",
+    "TPA004": "static/donate argnames/argnums do not match the jitted signature",
+    "TPA005": "donated argument reused after the donating call",
+    "TPA006": "broad `except Exception` in a library (non-CLI) module",
+}
+
+# Attribute reads that are concrete (host-side) even on a tracer.
+_LAUNDER_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding", "aval"})
+# Calls whose result is concrete regardless of argument taint.
+_LAUNDER_CALLS = frozenset({"len", "isinstance", "type", "id", "repr", "str"})
+
+_SUPPRESS_RE = re.compile(r"#\s*tpa:\s*disable(?:\s*=\s*([A-Z0-9_,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``fingerprint`` is line-number-free (code + file +
+    enclosing symbol + stripped source text) so baselines survive unrelated
+    edits above the finding."""
+
+    code: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+    snippet: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.symbol}:{self.snippet}"
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} [{self.symbol}] {self.message}"
+
+
+@dataclasses.dataclass
+class RulesReport:
+    findings: list[Finding]
+    baselined: list[Finding]
+    files_checked: int
+
+    @property
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "files_checked": self.files_checked,
+            "counts": self.counts,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+        }
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _literal_strs(node: ast.AST | None) -> list[str] | None:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def _literal_ints(node: ast.AST | None) -> list[int] | None:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """What one jit declaration pinned statically (literal values only;
+    non-literal expressions leave the field None = unknown)."""
+
+    node: ast.AST  # the decorator / call node, for line reporting
+    static_argnames: list[str] | None = None
+    static_argnums: list[int] | None = None
+    donate_argnums: list[int] | None = None
+    donate_argnames: list[str] | None = None
+    has_static_argnames_kw: bool = False
+    has_static_argnums_kw: bool = False
+    has_donate_kw: bool = False
+
+
+_JIT_NAMES = frozenset({"jax.jit", "jit", "pjit", "jax.pjit"})
+_PARTIAL_NAMES = frozenset({"partial", "functools.partial"})
+
+
+def _jit_call_spec(call: ast.Call) -> JitSpec:
+    spec = JitSpec(node=call)
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            spec.has_static_argnames_kw = True
+            spec.static_argnames = _literal_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            spec.has_static_argnums_kw = True
+            spec.static_argnums = _literal_ints(kw.value)
+        elif kw.arg == "donate_argnums":
+            spec.has_donate_kw = True
+            spec.donate_argnums = _literal_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            spec.has_donate_kw = True
+            spec.donate_argnames = _literal_strs(kw.value)
+    return spec
+
+
+def _decorator_jit_spec(dec: ast.AST) -> JitSpec | None:
+    """JitSpec when the decorator jits the function: ``@jax.jit`` or
+    ``@partial(jax.jit, ...)``."""
+    if _dotted(dec) in _JIT_NAMES:
+        return JitSpec(node=dec)
+    if isinstance(dec, ast.Call):
+        fname = _dotted(dec.func)
+        if fname in _JIT_NAMES:
+            return _jit_call_spec(dec)
+        if fname in _PARTIAL_NAMES and dec.args:
+            if _dotted(dec.args[0]) in _JIT_NAMES:
+                return _jit_call_spec(dec)
+    return None
+
+
+def _positional_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _all_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+# --------------------------------------------------------------------------
+# taint
+
+
+def _is_none_compare(node: ast.Compare) -> bool:
+    return all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and all(
+        isinstance(c, ast.Constant) and c.value is None for c in node.comparators
+    )
+
+
+def _tainted(node: ast.AST | None, tainted: set[str]) -> bool:
+    """Does ``node`` (an expression) derive from a traced value? Laundered
+    subtrees (shape/dtype metadata, ``len``, ``is None`` identity tests) are
+    concrete under trace and never propagate taint."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _LAUNDER_ATTRS:
+            return False
+        return _tainted(node.value, tainted)
+    if isinstance(node, ast.Compare) and _is_none_compare(node):
+        return False
+    if isinstance(node, ast.Call):
+        fname = _dotted(node.func)
+        if fname in _LAUNDER_CALLS:
+            return False
+        return any(_tainted(a, tainted) for a in node.args) or any(
+            _tainted(kw.value, tainted) for kw in node.keywords
+        )
+    if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False  # defining a closure is not itself a traced use
+    return any(_tainted(child, tainted) for child in ast.iter_child_nodes(node))
+
+
+def _target_names(target: ast.AST) -> list[str]:
+    """Plain names bound by an assignment target (tuple/star unpack included)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    return []
+
+
+class _JitBodyScanner:
+    """TPA001/TPA002 over one jitted function: an ordered statement walk
+    propagating a taint set seeded with the non-static parameters."""
+
+    def __init__(self, module: "_Module", fn: ast.FunctionDef, static: set[str]):
+        self.module = module
+        self.fn = fn
+        self.tainted: set[str] = {
+            p for p in _all_params(fn) if p not in static and p != "self"
+        }
+        self.findings: list[Finding] = []
+
+    def run(self) -> list[Finding]:
+        self._stmts(self.fn.body)
+        return self.findings
+
+    # -- statement dispatch, in source order
+    def _stmts(self, body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        # TPA002 scans each statement's own expressions (compound bodies are
+        # recursed as statements below, so taint state is current for them).
+        self._scan_numpy_calls(stmt)
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            if _tainted(stmt.value, self.tainted):
+                self.tainted.update(_target_names(stmt.target))
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if _tainted(stmt.test, self.tainted):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self.findings.append(
+                    self.module.finding(
+                        "TPA001",
+                        stmt,
+                        self.fn.name,
+                        f"Python `{kind}` on a traced value — use jnp.where/"
+                        "lax.cond/lax.while_loop (or mark the argument static)",
+                    )
+                )
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.For):
+            if _tainted(stmt.iter, self.tainted):
+                self.tainted.update(_target_names(stmt.target))
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs trace as part of the jitted program; their
+            # parameters are traced values too (lax.while_loop carries,
+            # vmapped bodies). Shadowing is handled by seeding a fresh
+            # scanner whose taint is the outer set plus the inner params.
+            inner = _JitBodyScanner(self.module, stmt, static=set())
+            inner.tainted |= {t for t in self.tainted if t not in _all_params(stmt)}
+            self.findings.extend(inner.run())
+
+    def _assign(self, targets: list[ast.AST], value: ast.AST) -> None:
+        names: list[str] = []
+        for t in targets:
+            names.extend(_target_names(t))
+        if _tainted(value, self.tainted):
+            self.tainted.update(names)
+        else:
+            self.tainted.difference_update(names)
+
+    def _scan_numpy_calls(self, stmt: ast.stmt) -> None:
+        """Scan the statement's HEADER expressions for numpy-on-tracer calls
+        (compound-statement bodies are recursed via ``_stmt``, so each call
+        site is scanned exactly once, with the taint state current)."""
+        roots: list[ast.AST]
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own scanner
+        if isinstance(stmt, ast.Assign):
+            roots = [stmt.value]
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign, ast.Return)):
+            roots = [stmt.value] if stmt.value is not None else []
+        elif isinstance(stmt, (ast.If, ast.While)):
+            roots = [stmt.test]
+        elif isinstance(stmt, ast.For):
+            roots = [stmt.iter]
+        elif isinstance(stmt, ast.With):
+            roots = [item.context_expr for item in stmt.items]
+        elif isinstance(stmt, ast.Try):
+            roots = []
+        else:
+            roots = [stmt]  # simple statement: walk it whole
+        for root in roots:
+            self._scan_numpy_exprs(root)
+
+    def _scan_numpy_exprs(self, root: ast.AST) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if not fname:
+                continue
+            base = fname.split(".", 1)[0]
+            if base not in self.module.numpy_aliases:
+                continue
+            args_tainted = any(_tainted(a, self.tainted) for a in node.args) or any(
+                _tainted(kw.value, self.tainted) for kw in node.keywords
+            )
+            if args_tainted:
+                self.findings.append(
+                    self.module.finding(
+                        "TPA002",
+                        node,
+                        self.fn.name,
+                        f"`{fname}` applied to a traced value — numpy "
+                        "materializes tracers; use jax.numpy",
+                    )
+                )
+
+
+# --------------------------------------------------------------------------
+# per-module analysis
+
+
+class _Module:
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.numpy_aliases = self._numpy_aliases()
+        self.is_cli = self._is_cli()
+        # (fn node, JitSpec) for decorator-form and resolvable
+        # assignment-form (``name = jax.jit(local_def, ...)``) jits.
+        self.jitted: list[tuple[ast.FunctionDef, JitSpec]] = []
+        self._collect_jits()
+
+    def _is_cli(self) -> bool:
+        parts = self.rel.replace(os.sep, "/").split("/")
+        return "cli" in parts or parts[-1] == "__main__.py"
+
+    def _numpy_aliases(self) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "numpy":
+                        out.add(alias.asname or "numpy")
+        return out
+
+    def _collect_jits(self) -> None:
+        defs = {
+            s.name: s for s in self.tree.body if isinstance(s, ast.FunctionDef)
+        }
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    spec = _decorator_jit_spec(dec)
+                    if spec is not None:
+                        self.jitted.append((node, spec))
+            elif isinstance(node, ast.Call) and _dotted(node.func) in _JIT_NAMES:
+                # assignment-form jax.jit(f, ...): analyzable when f is a
+                # module-level def in this file.
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = defs.get(node.args[0].id)
+                    if target is not None:
+                        self.jitted.append((target, _jit_call_spec(node)))
+
+    def finding(
+        self, code: str, node: ast.AST, symbol: str, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        snippet = (
+            self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        )
+        return Finding(
+            code=code,
+            path=self.rel,
+            line=line,
+            symbol=symbol,
+            message=message,
+            snippet=snippet,
+        )
+
+    def suppressed(self, f: Finding) -> bool:
+        if not 0 < f.line <= len(self.lines):
+            return False
+        m = _SUPPRESS_RE.search(self.lines[f.line - 1])
+        if not m:
+            return False
+        codes = m.group(1)
+        if codes is None:
+            return True  # blanket `# tpa: disable`
+        return f.code in {c.strip() for c in codes.split(",")}
+
+    # -- the rules ---------------------------------------------------------
+
+    def static_names_for(self, fn: ast.FunctionDef, spec: JitSpec) -> set[str]:
+        static = set(spec.static_argnames or ())
+        pos = _positional_params(fn)
+        for i in spec.static_argnums or ():
+            if 0 <= i < len(pos):
+                static.add(pos[i])
+        return static
+
+    def rule_tpa001_002(self) -> list[Finding]:
+        out: list[Finding] = []
+        for fn, spec in self.jitted:
+            static = self.static_names_for(fn, spec)
+            out.extend(_JitBodyScanner(self, fn, static).run())
+        return out
+
+    def rule_tpa003(self) -> list[Finding]:
+        mutable = self._mutable_module_names()
+        if not mutable:
+            return []
+        out: list[Finding] = []
+        for fn, _spec in self.jitted:
+            bound = set(_all_params(fn))
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign) else [node.target]
+                    )
+                    for t in targets:
+                        bound.update(_target_names(t))
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    bound.update(_all_params(node))
+                    bound.add(node.name)
+                elif isinstance(node, ast.For):
+                    bound.update(_target_names(node.target))
+                elif isinstance(node, ast.comprehension):
+                    bound.update(_target_names(node.target))
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in mutable
+                    and node.id not in bound
+                ):
+                    out.append(
+                        self.finding(
+                            "TPA003",
+                            node,
+                            fn.name,
+                            f"jitted function reads mutable module state "
+                            f"`{node.id}` — jit captures the value at trace "
+                            "time; pass it as an argument",
+                        )
+                    )
+        return out
+
+    def _mutable_module_names(self) -> set[str]:
+        mutable: set[str] = set()
+        assigned: dict[str, int] = {}
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for name in _target_names(t):
+                        assigned[name] = assigned.get(name, 0) + 1
+                        if isinstance(
+                            stmt.value,
+                            (
+                                ast.List,
+                                ast.Dict,
+                                ast.Set,
+                                ast.ListComp,
+                                ast.DictComp,
+                                ast.SetComp,
+                            ),
+                        ):
+                            mutable.add(name)
+                        elif isinstance(stmt.value, ast.Call) and _dotted(
+                            stmt.value.func
+                        ) in (
+                            "list",
+                            "dict",
+                            "set",
+                            "bytearray",
+                            "collections.defaultdict",
+                            "collections.deque",
+                            "collections.OrderedDict",
+                            "collections.Counter",
+                        ):
+                            mutable.add(name)
+        mutable.update(n for n, c in assigned.items() if c > 1)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Global):
+                mutable.update(node.names)
+        return mutable
+
+    def rule_tpa004(self) -> list[Finding]:
+        out: list[Finding] = []
+        for fn, spec in self.jitted:
+            params = set(_all_params(fn))
+            pos = _positional_params(fn)
+            has_varargs = fn.args.vararg is not None
+            for name in spec.static_argnames or ():
+                if name not in params:
+                    out.append(
+                        self.finding(
+                            "TPA004",
+                            spec.node,
+                            fn.name,
+                            f"static_argnames names {name!r}, which is not a "
+                            f"parameter of `{fn.name}` — the jit silently "
+                            "ignores it (or dies at call time)",
+                        )
+                    )
+            for label, nums in (
+                ("static_argnums", spec.static_argnums),
+                ("donate_argnums", spec.donate_argnums),
+            ):
+                for i in nums or ():
+                    if not has_varargs and not -len(pos) <= i < len(pos):
+                        out.append(
+                            self.finding(
+                                "TPA004",
+                                spec.node,
+                                fn.name,
+                                f"{label} index {i} is out of range for "
+                                f"`{fn.name}`'s {len(pos)} positional "
+                                "parameters",
+                            )
+                        )
+            for name in spec.donate_argnames or ():
+                if name not in params:
+                    out.append(
+                        self.finding(
+                            "TPA004",
+                            spec.node,
+                            fn.name,
+                            f"donate_argnames names {name!r}, which is not a "
+                            f"parameter of `{fn.name}`",
+                        )
+                    )
+        return out
+
+    def donating_registry(self) -> dict[str, set[int]]:
+        """bare function name -> donated positional indices (this module)."""
+        out: dict[str, set[int]] = {}
+        for fn, spec in self.jitted:
+            donated: set[int] = set(spec.donate_argnums or ())
+            pos = _positional_params(fn)
+            for name in spec.donate_argnames or ():
+                if name in pos:
+                    donated.add(pos.index(name))
+            if donated:
+                out[fn.name] = out.get(fn.name, set()) | donated
+        return out
+
+    def rule_tpa005(self, registry: dict[str, set[int]]) -> list[Finding]:
+        if not registry:
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_scan_donation_reuse(self, node, registry))
+        return out
+
+    def rule_tpa006(self) -> list[Finding]:
+        if self.is_cli:
+            return []
+        out: list[Finding] = []
+        enclosing = _enclosing_symbols(self.tree)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or _dotted(node.type) in (
+                "Exception",
+                "BaseException",
+            )
+            if not broad:
+                continue
+            # Cleanup handlers that unconditionally re-raise are structural
+            # pass-throughs, not swallowers.
+            if node.body and isinstance(node.body[-1], ast.Raise) and node.body[-1].exc is None:
+                continue
+            caught = "bare except" if node.type is None else f"except {_dotted(node.type)}"
+            out.append(
+                self.finding(
+                    "TPA006",
+                    node,
+                    enclosing.get(id(node), "<module>"),
+                    f"{caught} in a library module swallows unrelated "
+                    "failures — catch specific exception types (CLI "
+                    "answer-and-continue loops are exempt by location)",
+                )
+            )
+        return out
+
+
+def _enclosing_symbols(tree: ast.Module) -> dict[int, str]:
+    """Map id(node) -> nearest enclosing function/class name, for reporting."""
+    out: dict[int, str] = {}
+
+    def visit(node: ast.AST, symbol: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_symbol = symbol
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                child_symbol = child.name if symbol == "<module>" else f"{symbol}.{child.name}"
+            out[id(child)] = child_symbol
+            visit(child, child_symbol)
+
+    visit(tree, "<module>")
+    return out
+
+
+def _chain_prefixes(chain: str) -> list[str]:
+    parts = chain.split(".")
+    return [".".join(parts[: i + 1]) for i in range(len(parts))]
+
+
+def _scan_donation_reuse(
+    module: _Module,
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    registry: dict[str, set[int]],
+) -> list[Finding]:
+    """Linear (statement-order) scan for loads of a donated buffer after the
+    donating call. Loop bodies run twice so next-iteration reuse is seen.
+    Only bare-name calls (``f(...)``, not ``obj.f(...)``) resolve against
+    the registry — conservative, no false positives on bound methods."""
+    findings: list[Finding] = []
+    dead: dict[str, int] = {}  # chain -> donating call line
+    reported: set[tuple[str, int]] = set()
+
+    def loads_in(stmt: ast.stmt) -> list[tuple[str, ast.AST]]:
+        out = []
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                chain = _dotted(node)
+                if chain:
+                    out.append((chain, node))
+        return out
+
+    def rebinds_in(stmt: ast.stmt) -> list[str]:
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        chains: list[str] = []
+
+        def collect(t: ast.AST) -> None:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    collect(elt)
+            elif isinstance(t, ast.Starred):
+                collect(t.value)
+            else:
+                chain = _dotted(t)
+                if chain:
+                    chains.append(chain)
+
+        for t in targets:
+            collect(t)
+        return chains
+
+    def donations_in(stmt: ast.stmt) -> list[tuple[str, int]]:
+        out = []
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+                continue
+            donated = registry.get(node.func.id)
+            if not donated:
+                continue
+            for i in donated:
+                if i < len(node.args):
+                    chain = _dotted(node.args[i])
+                    if chain:
+                        out.append((chain, node.lineno))
+        return out
+
+    def process(body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            # 1) loads of already-dead chains are reuse-after-donation
+            for chain, node in loads_in(stmt):
+                for prefix in _chain_prefixes(chain):
+                    if prefix in dead and (prefix, node.lineno) not in reported:
+                        reported.add((prefix, node.lineno))
+                        findings.append(
+                            module.finding(
+                                "TPA005",
+                                node,
+                                fn.name,
+                                f"`{chain}` was donated at line "
+                                f"{dead[prefix]} — the buffer is invalidated; "
+                                "rebind it from the call result before reuse",
+                            )
+                        )
+            # 2) this statement's donating calls kill their buffer args
+            for chain, lineno in donations_in(stmt):
+                dead[chain] = lineno
+            # 3) rebinding resurrects the name
+            for chain in rebinds_in(stmt):
+                for k in [k for k in dead if k == chain or k.startswith(chain + ".")]:
+                    del dead[k]
+            # recurse
+            if isinstance(stmt, (ast.For, ast.While)):
+                process(stmt.body)
+                process(stmt.body)  # second pass: cross-iteration reuse
+                process(stmt.orelse)
+            elif isinstance(stmt, ast.If):
+                process(stmt.body)
+                process(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                process(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                process(stmt.body)
+                for h in stmt.handlers:
+                    process(h.body)
+                process(stmt.orelse)
+                process(stmt.finalbody)
+
+    process(fn.body)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def _package_root() -> str:
+    import transformer_tpu
+
+    return os.path.dirname(os.path.abspath(transformer_tpu.__file__))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(_package_root(), "analysis", "baseline.json")
+
+
+def load_baseline(path: str | None) -> dict[str, str]:
+    """fingerprint -> justification. Missing file = empty baseline."""
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: dict[str, str] = {}
+    for entry in data.get("findings", []):
+        out[entry["fingerprint"]] = entry.get("reason", "")
+    return out
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterable[tuple[str, str]]:
+    """(abs_path, display_path) for every .py under ``paths``."""
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            yield p, os.path.basename(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    full = os.path.join(dirpath, fname)
+                    yield full, os.path.relpath(full, os.path.dirname(p))
+
+
+def run_rules(
+    paths: list[str] | None = None,
+    baseline_path: str | None = None,
+    rules: Iterable[str] | None = None,
+) -> RulesReport:
+    """Run the lint rules over ``paths`` (default: the installed
+    ``transformer_tpu`` package). Findings suppressed inline or matched by
+    the baseline are split out; the remainder are actionable."""
+    if paths is None:
+        paths = [_package_root()]
+        if baseline_path is None:
+            baseline_path = default_baseline_path()
+    baseline = load_baseline(baseline_path)
+    active = set(rules) if rules is not None else set(RULES)
+
+    modules: list[_Module] = []
+    for full, rel in _iter_py_files(paths):
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            modules.append(_Module(full, rel, source))
+        except SyntaxError as e:
+            raise SyntaxError(f"cannot lint {full}: {e}") from e
+
+    # Cross-module donation registry: a donating jit in one module can be
+    # imported and called by name elsewhere.
+    registry: dict[str, set[int]] = {}
+    for m in modules:
+        for name, donated in m.donating_registry().items():
+            registry[name] = registry.get(name, set()) | donated
+
+    findings: list[Finding] = []
+    baselined: list[Finding] = []
+    for m in modules:
+        raw: list[Finding] = []
+        if active & {"TPA001", "TPA002"}:
+            raw.extend(
+                f for f in m.rule_tpa001_002() if f.code in active
+            )
+        if "TPA003" in active:
+            raw.extend(m.rule_tpa003())
+        if "TPA004" in active:
+            raw.extend(m.rule_tpa004())
+        if "TPA005" in active:
+            raw.extend(m.rule_tpa005(registry))
+        if "TPA006" in active:
+            raw.extend(m.rule_tpa006())
+        for f in raw:
+            if m.suppressed(f):
+                continue
+            if f.fingerprint in baseline:
+                baselined.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return RulesReport(
+        findings=findings, baselined=baselined, files_checked=len(modules)
+    )
+
+
+def write_baseline(report: RulesReport, path: str, reason: str = "grandfathered") -> None:
+    """Persist every current finding as the new baseline (the `--update-
+    baseline` workflow: lint, eyeball, grandfather what stays)."""
+    payload = {
+        "findings": [
+            {"fingerprint": f.fingerprint, "reason": reason, "line": f.line}
+            for f in (*report.findings, *report.baselined)
+        ]
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")

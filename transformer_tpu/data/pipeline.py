@@ -30,6 +30,7 @@ from collections.abc import Iterator
 import numpy as np
 
 from transformer_tpu.config import PAD_ID
+from transformer_tpu.data.seeding import epoch_rng
 from transformer_tpu.data.tokenizer import SubwordTokenizer
 
 
@@ -242,7 +243,7 @@ class Seq2SeqDataset:
             return
         order = np.arange(len(self.src))
         if self.shuffle:
-            rng = np.random.default_rng((self.seed, epoch))
+            rng = epoch_rng(self.seed, epoch)
             rng.shuffle(order)
         local = self.batch_size // self.shard_count
         lo = self.shard_index * local
@@ -266,7 +267,7 @@ class Seq2SeqDataset:
         (seed, epoch)-shuffled global order so an epoch interleaves widths
         (all-short-first would skew the gradient distribution mid-epoch).
         Deterministic across hosts: same permutations on every process."""
-        rng = np.random.default_rng((self.seed, epoch))
+        rng = epoch_rng(self.seed, epoch)
         plan: list[tuple[int, np.ndarray]] = []
         for b, members in enumerate(self._bucket_members):
             perm = (

@@ -1,0 +1,27 @@
+"""The ONE definition of the epoch-shuffle seeding contract.
+
+Every shuffling data path — the in-memory permutation, the bucketed batch
+plan, and the streaming reservoir buffer — must draw from a PRNG keyed on
+``(seed, epoch)``: deterministic given the pair, different across epochs,
+and identical on every host (multi-host training slices rows out of a
+GLOBAL batch order, so a drifting shuffle is silent batch corruption, not a
+slow path). NumPy's ``default_rng`` feeds the tuple through SeedSequence,
+so (0, 1) and (1, 0) land in unrelated streams — no manual mixing needed.
+
+Previously this construction was repeated verbatim in three places
+(``pipeline.Seq2SeqDataset.batches``, ``pipeline.Seq2SeqDataset.
+_bucketed_batches``, ``streaming.StreamingSeq2SeqDataset.batches``); a
+drift in any one of them would have been the corruption described above.
+(The native C++ loader derives its own splitmix64 seed — documented in
+``Seq2SeqDataset.prefetch`` — and is intentionally outside this contract.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def epoch_rng(seed: int, epoch: int) -> np.random.Generator:
+    """The framework-wide epoch-shuffle PRNG: Philox via ``default_rng``
+    keyed on ``(seed, epoch)``."""
+    return np.random.default_rng((seed, epoch))

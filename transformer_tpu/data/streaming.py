@@ -31,6 +31,7 @@ from collections.abc import Iterator
 import numpy as np
 
 from transformer_tpu.config import PAD_ID
+from transformer_tpu.data.seeding import epoch_rng
 from transformer_tpu.data.tokenizer import SubwordTokenizer
 
 
@@ -146,7 +147,7 @@ class StreamingSeq2SeqDataset:
             yield s, t
 
     def batches(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        rng = np.random.default_rng((self.seed, epoch))
+        rng = epoch_rng(self.seed, epoch)
         local = self.batch_size // self.shard_count
         lo = self.shard_index * local
 

@@ -147,7 +147,9 @@ def initialize_distributed(
         # (TPU pod metadata, SLURM, ...). Absent one, stay single-process.
         try:
             jax.distributed.initialize()
-        except Exception:
+        except (RuntimeError, ValueError, OSError):
+            # No cluster environment to auto-detect (missing coordinator
+            # address / unreachable peers): stay single-process.
             return
         return
     jax.distributed.initialize(

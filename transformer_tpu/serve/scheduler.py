@@ -258,7 +258,7 @@ class ContinuousScheduler:
             order, req = self._queue.popleft()
             try:
                 self._start(order, req)
-            except Exception as e:  # noqa: BLE001 — answers, never kills
+            except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — per-request isolation: ANY admission failure must answer this request alone, never kill co-batched ones
                 self._done[order] = {"error": f"{type(e).__name__}: {e}"}
 
     def _start(self, order: int, req: dict) -> None:
