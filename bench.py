@@ -58,6 +58,32 @@ _TOTAL_BUDGET_S = 220.0
 # quick probes catch a relay mid-restart; after that the stale banked row is
 # emitted immediately, leaving the driver's window untouched.
 _RELAY_MAX_PROBES = 3
+# Bench-infra attribution log (docs/OBSERVABILITY.md): relay-down probes,
+# failed attempts, and fallback-row emissions land here as JSONL events so a
+# round's flaky bench window is diagnosable afterwards with
+# `python -m transformer_tpu.obs summarize bench_events.jsonl`.
+_EVENTS_FILE = os.path.join(_REPO_DIR, "bench_events.jsonl")
+_events = None
+
+
+def _emit_event(kind: str, **fields) -> None:
+    """Best-effort structured event (EventLog itself downgrades OSError to a
+    one-time warning — attribution must never fail the benchmark)."""
+    global _events
+    if _events is False:
+        return
+    try:
+        if _events is None:
+            from transformer_tpu.obs import EventLog
+
+            _events = EventLog(_EVENTS_FILE)
+        _events.emit(kind, **fields)
+    except (ImportError, OSError) as e:
+        # ImportError: bench.py copied out of the repo. OSError: EventLog's
+        # constructor itself (open/makedirs) on an unwritable repo dir —
+        # emit() downgrades internally, but the constructor cannot.
+        print(f"bench attribution disabled: {e!r}", file=sys.stderr)
+        _events = False  # don't retry the constructor every event
 
 
 def _run_inner() -> None:
@@ -314,6 +340,10 @@ def main() -> None:
                 f"{remaining:.0f}s of budget left",
                 file=sys.stderr,
             )
+            _emit_event(
+                "bench.relay_probe", attempt=attempt, probe=relay_probes,
+                max_probes=_RELAY_MAX_PROBES, remaining_s=round(remaining, 1),
+            )
             if relay_probes >= _RELAY_MAX_PROBES:
                 break  # straight to the banked-row fallback
             time.sleep(min(2.0, remaining))
@@ -334,16 +364,26 @@ def main() -> None:
             )
         except subprocess.TimeoutExpired:
             last_err = "benchmark subprocess timed out (TPU tunnel hung?)"
+            _emit_event("bench.attempt", attempt=attempt, outcome="timeout")
             continue  # budget check at the top of the loop bounds this
         sys.stderr.write(proc.stderr)
         if proc.returncode == 0 and '"value"' in proc.stdout:
             sys.stdout.write(proc.stdout)
             _bank_success(proc.stdout)
+            _emit_event("bench.attempt", attempt=attempt, outcome="ok")
             return
         last_err = (proc.stderr or "") + (proc.stdout or "")
         if not _looks_retryable(last_err):
             infra_failure = False
+            _emit_event(
+                "bench.attempt", attempt=attempt, outcome="deterministic_failure",
+                rc=proc.returncode,
+            )
             break  # deterministic failure: retrying would just burn time
+        _emit_event(
+            "bench.attempt", attempt=attempt, outcome="retryable_failure",
+            rc=proc.returncode,
+        )
         time.sleep(min(5.0, max(deadline - time.monotonic(), 0.0)))
 
     # Final failure. Prefer the newest banked base row (clearly marked stale)
@@ -372,8 +412,19 @@ def main() -> None:
             out["stale_age_s"] = round(time.time() - float(row["ts"]), 1)
         elif row.get("source"):
             out["stale_provenance"] = row["source"]
+        _emit_event(
+            "bench.fallback_row", value=row["value"],
+            stale_source=out["stale_source"],
+            stale_age_s=out.get("stale_age_s"),
+            stale_reason=tail.splitlines()[-1] if tail else "",
+        )
         print(json.dumps(out))
         return  # rc=0: the line carries a real (if stale) measurement
+    _emit_event(
+        "bench.no_value",
+        infra_failure=infra_failure,
+        error=tail.splitlines()[-1] if tail else "no output",
+    )
     print(
         json.dumps(
             {

@@ -28,6 +28,14 @@ Contracts:
   max_new) int32 ids.
 - **train_step_dtypes** — one abstract optimizer step preserves every
   parameter's dtype (param_dtype, not compute dtype) and advances ``step``.
+- **telemetry_inert** — the obs instrumentation wrapper (``obs.telemetry
+  .timed_call``, which the Trainer installs around its jitted step
+  dispatches when telemetry is on) must produce a jaxpr BYTE-IDENTICAL to
+  the uninstrumented twin's for both the train step and the serving pool
+  step (the pool step is traced through the same wrapper here; the
+  scheduler's own recording is inline host code at step boundaries):
+  telemetry records host-side scalars and can never leak an operation into
+  traced code.
 """
 
 from __future__ import annotations
@@ -333,6 +341,93 @@ def check_train_step_dtypes(cfg: ModelConfig) -> str:
     return f"{len(after)} param leaves dtype-stable through the optimizer step"
 
 
+def check_telemetry_inert(cfg: ModelConfig) -> str:
+    """Instrumented and uninstrumented step functions must trace to
+    byte-identical jaxprs. The instrumented twin is built with the real
+    wrapper the telemetry-enabled Trainer installs around its step
+    dispatches (``obs.telemetry.timed_call`` feeding a live registry
+    histogram + counter); the serving pool step is traced through the same
+    wrapper. Any future 'improvement' that lets a recorded value flow back
+    into the computation — or adds so much as a ``convert_element_type`` to
+    the trace — fails here, rounds before a byte-identity serving test
+    would catch it on hardware. (The scheduler's own span recording is
+    inline host code at step boundaries; its inertness is pinned by the
+    byte-identity + zero-recompile tests in tests/test_obs.py.)"""
+    from transformer_tpu.obs import MetricsRegistry
+    from transformer_tpu.obs.telemetry import timed_call
+    from transformer_tpu.train.state import TrainState, make_optimizer
+    from transformer_tpu.train.trainer import make_train_step
+
+    import re
+
+    reg = MetricsRegistry()
+
+    def canon(jaxpr) -> str:
+        # custom_jvp equations print closure thunks with their memory
+        # address (`jvp_jaxpr_thunk=<function ... at 0x...>`); two traces of
+        # IDENTICAL programs differ there. Mask addresses, compare the rest
+        # byte-for-byte.
+        return re.sub(r"0x[0-9a-f]+", "0x", str(jaxpr))
+
+    def twins(fn):
+        wrapped = timed_call(
+            fn, reg.histogram("contract_seconds"), reg.counter("contract_total")
+        )
+        return fn, wrapped
+
+    checked = []
+
+    # -- train step ---------------------------------------------------------
+    train_cfg = TINY_TRAIN
+    if cfg.encoder_only:
+        train_cfg = dataclasses.replace(train_cfg, objective="mlm")
+    step_fn = make_train_step(cfg, train_cfg)
+    params = abstract_params(cfg)
+
+    def driver(step):
+        def init_and_step(params, src, tgt, rng):
+            tx = make_optimizer(cfg, train_cfg)
+            state = TrainState(
+                step=jnp.int32(0), params=params, opt_state=tx.init(params)
+            )
+            return step(state, src, tgt, rng)
+
+        return init_and_step
+
+    B, L = train_cfg.batch_size, train_cfg.sequence_length
+    plain, wrapped = twins(step_fn)
+    a = canon(jax.make_jaxpr(driver(plain))(params, _ids(B, L), _ids(B, L), _KEY))
+    b = canon(jax.make_jaxpr(driver(wrapped))(params, _ids(B, L), _ids(B, L), _KEY))
+    assert a == b, "timed_call changed the TRAIN step jaxpr — telemetry leaked into traced code"
+    checked.append("train_step")
+
+    # -- serving pool step (decoder-only exports) ---------------------------
+    if cfg.decoder_only:
+        from transformer_tpu.models.decoder import init_decoder_caches
+        from transformer_tpu.serve.scheduler import _pool_step
+
+        slots, total = 2, 16
+        per_slot = jax.eval_shape(lambda: init_decoder_caches(cfg, 1, total))
+        pool = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct((slots, *x.shape), x.dtype), per_slot
+        )
+        toks = jax.ShapeDtypeStruct((slots,), np.int32)
+        raw = _pool_step.__wrapped__
+        plain, wrapped = twins(lambda p, c, t: raw(p, c, t, cfg))
+        a = canon(jax.make_jaxpr(plain)(params, pool, toks))
+        b = canon(jax.make_jaxpr(wrapped)(params, pool, toks))
+        assert a == b, (
+            "timed_call changed the POOL step jaxpr — telemetry leaked into "
+            "traced serving code"
+        )
+        checked.append("pool_step")
+    assert reg.histogram("contract_seconds").hist.count >= len(checked), (
+        "the instrumented twin never recorded — the contract exercised a "
+        "dead wrapper"
+    )
+    return f"jaxpr-identical twins: {', '.join(checked)}"
+
+
 # --------------------------------------------------------------------------
 # driver
 
@@ -343,6 +438,7 @@ _CONTRACTS: list[tuple[str, Callable[[ModelConfig], str], Callable[[ModelConfig]
     ("mask_broadcast", check_mask_broadcast, lambda c: True),
     ("decode_shapes", check_decode_shapes, lambda c: not c.encoder_only),
     ("train_step_dtypes", check_train_step_dtypes, lambda c: True),
+    ("telemetry_inert", check_telemetry_inert, lambda c: True),
 ]
 
 

@@ -133,8 +133,11 @@ def main(argv) -> None:
     import datetime
 
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
-    from transformer_tpu.cli.flags import flags_to_profiler
+    from transformer_tpu.cli.flags import flags_to_profiler, flags_to_telemetry
 
+    # Host 0 owns telemetry, like logs/checkpoints: per-host event files
+    # would interleave badly and the metrics are already globally reduced.
+    telemetry = flags_to_telemetry() if jax.process_index() == 0 else None
     trainer = DistributedTrainer(
         model_cfg, train_cfg, mesh,
         log_dir=os.path.join(FLAGS.tb_log_dir, stamp)
@@ -143,6 +146,7 @@ def main(argv) -> None:
         checkpoint=ckpt,
         log_fn=logging.info,
         profiler=flags_to_profiler() if jax.process_index() == 0 else None,
+        telemetry=telemetry,
     )
     if FLAGS.consistency_check:
         from transformer_tpu.utils.consistency import (
@@ -209,6 +213,8 @@ def main(argv) -> None:
                 limit=FLAGS.bleu_limit,
                 log_fn=logging.info,
             )
+    if telemetry is not None:
+        telemetry.close()
 
 
 def run() -> None:

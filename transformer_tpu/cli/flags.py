@@ -55,6 +55,29 @@ def apply_preset() -> None:
             setattr(FLAGS, name, value)
 
 
+def define_metrics_flags() -> None:
+    """Telemetry knobs (docs/OBSERVABILITY.md) — shared by the training
+    CLIs (via ``define_flags``) and the export-serving CLIs (``cli.serve``
+    defines its own surface), hence the idempotence guard."""
+    if "metrics_jsonl" in FLAGS:
+        return
+    flags.DEFINE_string(
+        "metrics_jsonl", "",
+        "write structured telemetry (JSONL events + periodic metric "
+        "snapshots) to this file; a Prometheus text exposition is rewritten "
+        "alongside it at <file>.prom. Summarize with "
+        "`python -m transformer_tpu.obs summarize <file>`. '' = off")
+    flags.DEFINE_integer(
+        "metrics_port", 0,
+        "serve a Prometheus /metrics scrape endpoint on this port "
+        "(0 = off; train/distributed_train/serve). Works with or without "
+        "--metrics_jsonl")
+    flags.DEFINE_float(
+        "metrics_interval", 10.0,
+        "seconds between periodic metric-snapshot flushes (prom file + "
+        "metrics.snapshot events)")
+
+
 def define_flags() -> None:
     flags.DEFINE_enum(
         "preset", "", ["", *sorted(_PRESETS)],
@@ -175,6 +198,7 @@ def define_flags() -> None:
     flags.DEFINE_string("profile_dir", "", "capture a jax.profiler trace into this dir")
     flags.DEFINE_integer("profile_start_step", 2, "first step of the profile window")
     flags.DEFINE_integer("profile_num_steps", 3, "profile window length in steps")
+    define_metrics_flags()
     # --- mesh knobs (distributed) ---
     flags.DEFINE_integer("dp", 0, "data-parallel mesh size (0 = all devices)")
     flags.DEFINE_integer("fsdp", 1, "fsdp (param-shard) mesh size")
@@ -334,6 +358,31 @@ def flags_to_profiler():
         start_step=FLAGS.profile_start_step,
         num_steps=FLAGS.profile_num_steps,
     )
+
+
+def flags_to_telemetry():
+    """obs.Telemetry from --metrics_* flags, or None when telemetry is off
+    (--metrics_jsonl unset and --metrics_port 0 — the zero-overhead
+    default). Owns the whole --metrics_* interpretation, including starting
+    the /metrics scrape endpoint, so every CLI wires telemetry identically.
+    The jax-free obs import keeps flag materialization safe to run before
+    platform setup, like the rest of this module."""
+    if not FLAGS.metrics_jsonl and not FLAGS.metrics_port:
+        return None
+    from absl import logging
+
+    from transformer_tpu.obs import EventLog, Telemetry
+
+    events = EventLog(FLAGS.metrics_jsonl) if FLAGS.metrics_jsonl else None
+    telemetry = Telemetry(
+        events=events,
+        prom_path=f"{FLAGS.metrics_jsonl}.prom" if FLAGS.metrics_jsonl else None,
+        interval=FLAGS.metrics_interval,
+    )
+    if FLAGS.metrics_port:
+        port = telemetry.start_prometheus_server(FLAGS.metrics_port)
+        logging.info("Prometheus /metrics on port %d", port)
+    return telemetry
 
 
 def flags_to_mesh_config(n_devices: int) -> MeshConfig:

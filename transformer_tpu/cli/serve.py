@@ -37,6 +37,10 @@ mid-flight via single-pass chunked prefill (``--prefill_chunk``) — a
 straggler with a long generation no longer holds a whole batch's chip time
 hostage. ``--serve_slots=0`` restores the grouped decode-to-completion
 path. See docs/SERVING.md.
+
+Telemetry: ``--metrics_jsonl`` streams structured events (per-request spans,
+slot utilization) + periodic metric snapshots, and ``--metrics_port`` serves
+a Prometheus ``/metrics`` scrape endpoint — docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -45,6 +49,7 @@ import json
 import queue
 import sys
 import threading
+import time
 
 from absl import app, flags, logging
 
@@ -52,9 +57,11 @@ FLAGS = flags.FLAGS
 
 
 def define_serve_flags() -> None:
+    from transformer_tpu.cli.flags import define_metrics_flags
     from transformer_tpu.cli.translate import define_export_serving_flags
 
     define_export_serving_flags()
+    define_metrics_flags()
     flags.DEFINE_integer(
         "serve_batch", 8,
         "max already-queued requests aggregated into one decode (grouped by "
@@ -266,7 +273,7 @@ def _route_lm_request(line: str, model_cfg) -> dict:
     return req
 
 
-def serve_continuous(q: queue.Queue, sched, model_cfg) -> None:
+def serve_continuous(q: queue.Queue, sched, model_cfg, telemetry=None) -> None:
     """Drive the continuous-batching scheduler from the stdin queue: ingest
     whatever is already queued (malformed lines answer immediately via a
     reserved output position — ordering is preserved), admit queued requests
@@ -306,6 +313,8 @@ def serve_continuous(q: queue.Queue, sched, model_cfg) -> None:
         sched.step()
         for resp in sched.drain_ready():
             print(json.dumps(resp), flush=True)
+    if telemetry is not None:
+        telemetry.maybe_flush(force=True)
 
 
 def _stdin_reader(q: queue.Queue) -> None:
@@ -316,9 +325,10 @@ def _stdin_reader(q: queue.Queue) -> None:
 
 def main(argv) -> None:
     del argv
-    from transformer_tpu.cli.flags import maybe_force_platform
+    from transformer_tpu.cli.flags import flags_to_telemetry, maybe_force_platform
 
     maybe_force_platform()
+    telemetry = flags_to_telemetry()
 
     from transformer_tpu.cli.translate import load_export
     from transformer_tpu.data.tokenizer import SubwordTokenizer
@@ -361,8 +371,11 @@ def main(argv) -> None:
             max_total=FLAGS.serve_max_total or None,
             prefill_chunk=FLAGS.prefill_chunk,
             default_max_new=FLAGS.max_len,
+            telemetry=telemetry,
         )
-        serve_continuous(q, sched, model_cfg)
+        serve_continuous(q, sched, model_cfg, telemetry=telemetry)
+        if telemetry is not None:
+            telemetry.close()
         return
     eof = False
     while not eof:
@@ -385,12 +398,33 @@ def main(argv) -> None:
         lines = [line for line in lines if line]
         if not lines:
             continue
-        for resp in serve_lines(
+        t0 = time.perf_counter()
+        responses = serve_lines(
             lines, params, model_cfg, src_tok, tgt_tok,
             default_max_len=FLAGS.max_len, default_beam=FLAGS.beam,
             prefill_chunk=FLAGS.prefill_chunk,
-        ):
+        )
+        if telemetry is not None:
+            # Grouped path: one span per drained batch (the per-request
+            # breakdown is the continuous scheduler's richer contract).
+            batch_s = time.perf_counter() - t0
+            errors = sum(1 for r in responses if "error" in r)
+            reg = telemetry.registry
+            reg.counter("serve_requests_total").inc(len(responses))
+            if errors:
+                reg.counter("serve_errors_total").inc(errors)
+            reg.histogram(
+                "serve_batch_seconds", "one grouped decode batch"
+            ).observe(batch_s)
+            telemetry.emit(
+                "serve.batch", size=len(responses), errors=errors,
+                batch_s=round(batch_s, 6),
+            )
+            telemetry.maybe_flush()
+        for resp in responses:
             print(json.dumps(resp), flush=True)
+    if telemetry is not None:
+        telemetry.close()
 
 
 def run() -> None:

@@ -113,16 +113,20 @@ def main(argv) -> None:
     import datetime
 
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
-    from transformer_tpu.cli.flags import flags_to_profiler
+    from transformer_tpu.cli.flags import flags_to_profiler, flags_to_telemetry
 
+    telemetry = flags_to_telemetry()
     trainer = Trainer(
         model_cfg, train_cfg, state,
         log_dir=os.path.join(FLAGS.tb_log_dir, stamp),
         checkpoint=ckpt,
         log_fn=logging.info,
         profiler=flags_to_profiler(),
+        telemetry=telemetry,
     )
     trainer.fit(train_ds, test_ds)
+    if telemetry is not None:
+        telemetry.close()
 
     if lm_mode:
         # LM quality metric: perplexity from fit()'s final-epoch full eval

@@ -1,0 +1,41 @@
+"""transformer_tpu.obs — unified telemetry.
+
+A dependency-free (stdlib-only) observability core: a metrics registry
+(counters / gauges / histograms with online p50/p95/p99), a structured JSONL
+event log, and three sinks — JSONL, Prometheus text exposition (file and/or
+``/metrics`` endpoint), and the ``utils/tensorboard.py`` tfevents writer.
+``python -m transformer_tpu.obs summarize <jsonl>`` renders a run report.
+
+Import rule: nothing under ``transformer_tpu.obs`` may import jax or numpy.
+Telemetry records host-side scalars at existing sync points; keeping the
+package structurally device-free is what makes the ``telemetry_inert``
+contract (``analysis/contracts.py``) and the serving byte-identity guarantee
+cheap to uphold. See docs/OBSERVABILITY.md.
+"""
+
+from transformer_tpu.obs.events import EventLog, read_events
+from transformer_tpu.obs.quantiles import StreamingHistogram
+from transformer_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from transformer_tpu.obs.telemetry import (
+    Telemetry,
+    device_memory_stats,
+    timed_call,
+)
+
+__all__ = [
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "StreamingHistogram",
+    "Telemetry",
+    "device_memory_stats",
+    "read_events",
+    "timed_call",
+]

@@ -1,0 +1,261 @@
+"""``python -m transformer_tpu.obs summarize <jsonl>`` — run report.
+
+Aggregates a structured event log (docs/OBSERVABILITY.md schema) into the
+operator-facing numbers the ISSUE names: tokens/s, step p50/p95, slot
+utilization, and the per-request latency breakdown (queue → prefill →
+first-token → total). Works on logs from a train run, a serve session, or a
+file that interleaves both (the aggregator keys on ``kind``). CPU-only,
+jax-free — safe to run on a laptop against a log scp'd off a TPU host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from transformer_tpu.obs.events import read_events
+from transformer_tpu.obs.quantiles import StreamingHistogram
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def _span_quantiles(reqs: list[dict], field: str) -> dict | None:
+    h = StreamingHistogram()
+    for r in reqs:
+        v = r.get(field)
+        if isinstance(v, (int, float)) and v >= 0:
+            h.observe(v)
+    return h.snapshot() if h.count else None
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Event list -> JSON-able report (the text renderer formats this)."""
+    report: dict = {"events": len(events)}
+
+    # ---- serve: per-request spans ----------------------------------------
+    reqs = [e for e in events if e.get("kind") == "serve.request"]
+    if reqs:
+        ok = [r for r in reqs if "error" not in r]
+        spans = {}
+        for field in ("queue_s", "prefill_s", "ttft_s", "total_s"):
+            q = _span_quantiles(ok, field)
+            if q:
+                spans[field] = q
+        gen_tokens = sum(int(r.get("new_tokens", 0)) for r in ok)
+        busy_s = sum(
+            float(r["total_s"]) for r in ok
+            if isinstance(r.get("total_s"), (int, float))
+        )
+        report["serve"] = {
+            "requests": len(reqs),
+            "errors": len(reqs) - len(ok),
+            "generated_tokens": gen_tokens,
+            "spans": spans,
+            # In-flight tokens/s: generated tokens over summed per-request
+            # residency. With N slots busy the wall-clock rate is ~N× this.
+            "tokens_per_request_second": (
+                round(gen_tokens / busy_s, 2) if busy_s > 0 else None
+            ),
+        }
+
+    # ---- serve: grouped-path batches --------------------------------------
+    batches = [e for e in events if e.get("kind") == "serve.batch"]
+    if batches:
+        h = StreamingHistogram()
+        for b in batches:
+            v = b.get("batch_s")
+            if isinstance(v, (int, float)) and v >= 0:
+                h.observe(v)
+        report["serve_grouped"] = {
+            "batches": len(batches),
+            "requests": sum(int(b.get("size", 0)) for b in batches),
+            "errors": sum(int(b.get("errors", 0)) for b in batches),
+            "batch_s": h.snapshot() if h.count else None,
+        }
+
+    # ---- serve: slot utilization from metric snapshots -------------------
+    snaps = [e for e in events if e.get("kind") == "metrics.snapshot"]
+    if snaps:
+        utils = []
+        for s in snaps:
+            m = s.get("metrics", {})
+            active, total = m.get("serve_slots_active"), m.get("serve_slots_total")
+            if isinstance(active, (int, float)) and total:
+                utils.append(active / total)
+        if utils:
+            report.setdefault("serve", {})["slot_utilization"] = {
+                "mean": round(sum(utils) / len(utils), 4),
+                "max": round(max(utils), 4),
+                "samples": len(utils),
+            }
+        last = snaps[-1].get("metrics", {})
+        step_hist = last.get("serve_step_seconds")
+        if isinstance(step_hist, dict) and step_hist.get("count"):
+            report.setdefault("serve", {})["step_seconds"] = step_hist
+
+    # ---- train: throughput + step-time quantiles -------------------------
+    windows = [e for e in events if e.get("kind") == "train.window"]
+    if windows:
+        steps = sum(int(w.get("steps", 0)) for w in windows)
+        tokens = sum(int(w.get("tokens", 0)) for w in windows)
+        wall = sum(float(w.get("window_s", 0.0)) for w in windows)
+        h = StreamingHistogram()
+        for w in windows:
+            n = int(w.get("steps", 0))
+            ws = float(w.get("window_s", 0.0))
+            if n > 0 and ws > 0:
+                # A window's wall time, attributed evenly to its steps —
+                # the same accounting StepTimer.sync() uses.
+                h.observe(ws / n, n=n)
+        last = windows[-1]
+        report["train"] = {
+            "windows": len(windows),
+            "steps": steps,
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else None,
+            "steps_per_sec": round(steps / wall, 2) if wall > 0 else None,
+            "step_seconds": h.snapshot() if h.count else None,
+            "final": {
+                k: last[k]
+                for k in ("loss", "accuracy", "grad_norm", "step")
+                if k in last
+            },
+        }
+        compiles = [e for e in events if e.get("kind") == "train.compile"]
+        if compiles:
+            report["train"]["compiles"] = compiles[-1].get("cache_sizes")
+        mem = [e for e in events if e.get("kind") == "train.memory"]
+        if mem:
+            report["train"]["memory"] = mem[-1].get(
+                "devices", mem[-1].get("stats")
+            )
+
+    # ---- bench attribution ----------------------------------------------
+    bench = [e for e in events if str(e.get("kind", "")).startswith("bench.")]
+    if bench:
+        counts: dict[str, int] = {}
+        for e in bench:
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        report["bench"] = counts
+
+    return report
+
+
+def render_text(report: dict) -> str:
+    lines = [f"{report['events']} events"]
+    serve = report.get("serve")
+    if serve:
+        # A serve section can exist with only snapshot-derived fields (a
+        # session scraped before any request finished) — .get throughout.
+        lines.append(
+            f"serve: {serve.get('requests', 0)} requests "
+            f"({serve.get('errors', 0)} errored), "
+            f"{serve.get('generated_tokens', 0)} tokens generated"
+        )
+        util = serve.get("slot_utilization")
+        if util:
+            lines.append(
+                f"  slot utilization: mean {util['mean'] * 100:.1f}%, "
+                f"max {util['max'] * 100:.1f}% over {util['samples']} samples"
+            )
+        if serve.get("tokens_per_request_second"):
+            lines.append(
+                f"  decode rate: {serve['tokens_per_request_second']} "
+                "tokens/s per in-flight request"
+            )
+        for field, label in (
+            ("queue_s", "queue"), ("prefill_s", "prefill"),
+            ("ttft_s", "first token"), ("total_s", "total"),
+        ):
+            q = serve.get("spans", {}).get(field)
+            if q:
+                lines.append(
+                    f"  {label:>11}: p50 {_fmt_s(q['p50'])}  "
+                    f"p95 {_fmt_s(q['p95'])}  p99 {_fmt_s(q['p99'])}  "
+                    f"max {_fmt_s(q['max'])}"
+                )
+        step = serve.get("step_seconds")
+        if step:
+            lines.append(
+                f"  scheduler step: p50 {_fmt_s(step['p50'])}  "
+                f"p95 {_fmt_s(step['p95'])} over {step['count']} steps"
+            )
+    grouped = report.get("serve_grouped")
+    if grouped:
+        line = (
+            f"serve (grouped): {grouped['requests']} requests "
+            f"({grouped['errors']} errored) in {grouped['batches']} batches"
+        )
+        if grouped.get("batch_s"):
+            q = grouped["batch_s"]
+            line += f"; batch p50 {_fmt_s(q['p50'])}  p95 {_fmt_s(q['p95'])}"
+        lines.append(line)
+    train = report.get("train")
+    if train:
+        tps = train.get("tokens_per_sec")
+        lines.append(
+            f"train: {train['steps']} steps, {train['tokens']} tokens"
+            + (f", {tps:,.0f} tokens/s" if tps else "")
+        )
+        step = train.get("step_seconds")
+        if step:
+            lines.append(
+                f"  step time: p50 {_fmt_s(step['p50'])}  "
+                f"p95 {_fmt_s(step['p95'])}  p99 {_fmt_s(step['p99'])}"
+            )
+        final = train.get("final", {})
+        if final:
+            parts = [f"{k} {final[k]:.4f}" if isinstance(final[k], float)
+                     else f"{k} {final[k]}" for k in sorted(final)]
+            lines.append("  final: " + ", ".join(parts))
+        if train.get("compiles"):
+            total = sum(train["compiles"].values())
+            lines.append(f"  jit programs compiled: {total} {train['compiles']}")
+        if train.get("memory"):
+            lines.append(f"  device memory: {train['memory']}")
+    bench = report.get("bench")
+    if bench:
+        lines.append(
+            "bench: " + ", ".join(f"{k.split('.', 1)[1]} x{v}"
+                                  for k, v in sorted(bench.items()))
+        )
+    if len(lines) == 1:
+        lines.append("no serve/train/bench telemetry kinds found")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m transformer_tpu.obs",
+        description="telemetry tools (docs/OBSERVABILITY.md)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_sum = sub.add_parser(
+        "summarize", help="render a run report from a JSONL event log"
+    )
+    p_sum.add_argument("jsonl", help="event log written via --metrics_jsonl")
+    p_sum.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is diff-able across runs)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        events = read_events(args.jsonl)
+    except OSError as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 2
+    report = summarize_events(events)
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Structured JSONL event log.
+
+One event per line: ``{"ts": <unix seconds>, "kind": "<dotted.kind>", ...}``.
+The kinds this repo emits (schema in docs/OBSERVABILITY.md):
+
+- ``serve.request`` — one per finished/errored request: the full span
+  breakdown (queue/prefill/first-token/total seconds, token counts).
+- ``serve.batch`` — one per grouped-path decode batch.
+- ``train.window`` — one per closed StepTimer window (log/eval/epoch
+  boundary): steps, tokens, throughput, loss/accuracy/grad-norm.
+- ``train.memory`` / ``train.compile`` — device memory stats and jit
+  compile-cache accounting at epoch boundaries.
+- ``metrics.snapshot`` — periodic full registry dump (histograms as
+  count/sum/min/max/p50/p95/p99).
+- ``bench.relay_probe`` / ``bench.fallback_row`` / ``bench.attempt`` —
+  bench-infra attribution (bench.py), so a flaky relay is diagnosable from
+  the log after the fact.
+
+Writes are line-buffered and lock-guarded (the serve CLI's flush thread and
+its main loop share one log). A full disk must never kill the process being
+observed: OSError on write downgrades to a one-time stderr warning and the
+log goes quiet — telemetry is an instrument, not a dependency.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+
+class EventLog:
+    """Append-only JSONL event writer."""
+
+    def __init__(self, path_or_file: "str | io.TextIOBase") -> None:
+        self._lock = threading.Lock()
+        self._broken = False
+        if isinstance(path_or_file, str):
+            d = os.path.dirname(os.path.abspath(path_or_file))
+            os.makedirs(d, exist_ok=True)
+            self._file = open(path_or_file, "a", buffering=1)
+            self.path: str | None = path_or_file
+            self._owns = True
+        else:
+            self._file = path_or_file
+            self.path = getattr(path_or_file, "name", None)
+            self._owns = False
+
+    def emit(self, kind: str, **fields) -> None:
+        """Append one event. ``fields`` must be JSON-serializable; a ``ts``
+        stamp is added unless the caller supplies one (bench.py backfills)."""
+        if self._broken:
+            return
+        event = {"ts": fields.pop("ts", None) or round(time.time(), 6),
+                 "kind": kind, **fields}
+        line = json.dumps(event, sort_keys=False)
+        try:
+            with self._lock:
+                self._file.write(line + "\n")
+        except (OSError, ValueError):  # ValueError: write to a closed file
+            self._broken = True
+            print(
+                f"obs: event log {self.path or '<stream>'} unwritable; "
+                "telemetry disabled for this process",
+                file=sys.stderr,
+            )
+
+    def flush(self) -> None:
+        if self._broken:
+            return
+        try:
+            with self._lock:
+                self._file.flush()
+        except (OSError, ValueError):
+            self._broken = True
+
+    def close(self) -> None:
+        self.flush()
+        if self._owns:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+
+
+def read_events(path: str, kind: str | None = None) -> list[dict]:
+    """Load a JSONL event log; malformed lines (a crash mid-write) are
+    skipped, never fatal — the summarize CLI must work on truncated logs."""
+    out: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and (kind is None or ev.get("kind") == kind):
+                out.append(ev)
+    return out

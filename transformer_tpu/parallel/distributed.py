@@ -421,6 +421,9 @@ def make_1f1b_train_step(
             "loss_sum": sums["loss_sum"],
             "weight": sums["weight"],
             "correct": sums["correct"],
+            # Same training-health scalar trainer._apply reports, computed
+            # on the manually-assembled 1F1B gradients.
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
         }
         if moe:
             # The engine already normalized its aux to the GPipe forward's
@@ -529,7 +532,10 @@ def _raw_sharded_steps(
 def _metric_shardings(mesh: Mesh, model_cfg: ModelConfig) -> dict:
     repl = NamedSharding(mesh, P())
     metrics_sh = {
-        "loss": repl, "loss_sum": repl, "weight": repl, "correct": repl
+        "loss": repl, "loss_sum": repl, "weight": repl, "correct": repl,
+        # grad_norm: every train-step builder (trainer._apply, the 1F1B
+        # manual path) emits it; out_shardings must mirror the pytree.
+        "grad_norm": repl,
     }
     if model_cfg.moe_experts:
         metrics_sh["moe_aux"] = repl
@@ -558,10 +564,12 @@ def make_sharded_steps(
         out_shardings=(shardings, metrics_sh),
         donate_argnums=(0,) if donate else (),
     )
+    # Eval is forward-only: its metric pytree has no grad_norm leaf.
+    eval_sh = {k: v for k, v in metrics_sh.items() if k != "grad_norm"}
     eval_step = jax.jit(
         raw_eval,
         in_shardings=(shardings, data_sh, data_sh),
-        out_shardings=metrics_sh,
+        out_shardings=eval_sh,
     )
     return train_step, eval_step
 
@@ -725,6 +733,11 @@ class DistributedTrainer(Trainer):
                 donate=donate,
             )
             self.multi_step = self._sharded_multi_step
+        if self.telemetry is not None:
+            # The plain-step wrappers installed by Trainer.__init__ were just
+            # replaced by the sharded steps — re-route them through the
+            # dispatch-timing wrapper.
+            self._wrap_steps_for_dispatch_timing()
 
     def _sharded_train_step(self, state, src, tgt, rng):
         src = put_batch(np.asarray(src), self.mesh, self.shard_seq)

@@ -34,11 +34,18 @@ Per-request error isolation (the ``cli/serve.py`` grouped-path guarantee)
 holds structurally here: requests fail at admission (encode/validation) —
 one poisoned request answers with its error and never enters the pool, so
 co-batched requests are untouched.
+
+With a ``telemetry=`` handle (``obs.Telemetry``, docs/OBSERVABILITY.md) the
+scheduler records per-request spans (enqueue→admit→prefill→first-token→
+finish), slot-occupancy/backlog gauges, and admission/retirement/error
+counters — all host-side at step boundaries: answers stay byte-identical
+and the hot path compiles the same programs (both pinned in tests).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 
@@ -145,6 +152,12 @@ class _Active:
     temperature: float
     top_k: int
     top_p: float
+    # Span clock (host perf_counter; None until the edge is reached):
+    # enqueue -> admit -> prefill-dispatched -> first token -> finish.
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_prefill: float | None = None
+    t_first: float | None = None
 
 
 class SlotPool:
@@ -186,6 +199,7 @@ class ContinuousScheduler:
         max_total: int | None = None,
         prefill_chunk: int = 0,
         default_max_new: int = 64,
+        telemetry=None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -201,10 +215,49 @@ class ContinuousScheduler:
         self._free = list(range(num_slots))
         self._active: dict[int, _Active] = {}
         self._queue: deque[tuple[int, dict]] = deque()
+        self._enqueue_t: dict[int, float] = {}  # order -> submit() time
         self._done: dict[int, dict] = {}
         self._next_order = 0
         self._emit_next = 0
         self.stats = {"admitted": 0, "steps": 0, "max_active": 0}
+        # Telemetry (obs.Telemetry | None) records host-side scalars only, at
+        # the step/admission boundaries that already exist — answers stay
+        # byte-identical (tests/test_obs.py pins this) and the decode hot
+        # path compiles the same programs (retrace budget stays 0).
+        self._tel = telemetry
+        if telemetry is not None:
+            reg = telemetry.registry
+            self._m_slots_total = reg.gauge(
+                "serve_slots_total", "configured KV-cache slots")
+            self._m_slots_total.set(num_slots)
+            self._m_active = reg.gauge(
+                "serve_slots_active", "slots occupied by in-flight requests")
+            self._m_backlog = reg.gauge(
+                "serve_backlog", "submitted-but-not-admitted requests")
+            self._m_ready = reg.gauge(
+                "serve_ready", "completed responses awaiting drain")
+            self._m_requests = reg.counter(
+                "serve_requests_total", "requests submitted (incl. errors)")
+            self._m_admissions = reg.counter(
+                "serve_admissions_total", "requests admitted into a slot")
+            self._m_retirements = reg.counter(
+                "serve_retirements_total", "requests finished and retired")
+            self._m_errors = reg.counter(
+                "serve_errors_total", "requests answered with an error")
+            self._m_steps = reg.counter(
+                "serve_steps_total", "pool decode steps executed")
+            self._m_tokens = reg.counter(
+                "serve_generated_tokens_total", "tokens emitted to clients")
+            self._m_queue_s = reg.histogram(
+                "serve_queue_seconds", "submit -> slot admission")
+            self._m_prefill_s = reg.histogram(
+                "serve_prefill_seconds", "admission -> prompt ingested")
+            self._m_ttft_s = reg.histogram(
+                "serve_ttft_seconds", "submit -> first generated token")
+            self._m_total_s = reg.histogram(
+                "serve_request_seconds", "submit -> response complete")
+            self._m_step_s = reg.histogram(
+                "serve_step_seconds", "one pool step (all slots, one token)")
 
     # ---- request intake ---------------------------------------------------
 
@@ -212,12 +265,23 @@ class ContinuousScheduler:
         order = self._next_order
         self._next_order += 1
         self._queue.append((order, req))
+        self._enqueue_t[order] = time.perf_counter()
+        if self._tel is not None:
+            self._m_requests.inc()
         return order
 
     def submit_done(self, resp: dict) -> int:
         order = self._next_order
         self._next_order += 1
         self._done[order] = resp
+        if self._tel is not None:
+            self._m_requests.inc()
+            if "error" in resp:
+                self._m_errors.inc()
+            self._tel.emit(
+                "serve.request", order=order, total_s=0.0,
+                **({"error": resp["error"]} if "error" in resp else {}),
+            )
         return order
 
     @property
@@ -256,12 +320,22 @@ class ContinuousScheduler:
         never enters the pool, so it cannot poison co-batched requests."""
         while self._free and self._queue:
             order, req = self._queue.popleft()
+            t_enq = self._enqueue_t.pop(order, 0.0)
             try:
-                self._start(order, req)
+                self._start(order, req, t_enq)
             except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — per-request isolation: ANY admission failure must answer this request alone, never kill co-batched ones
                 self._done[order] = {"error": f"{type(e).__name__}: {e}"}
+                if self._tel is not None:
+                    now = time.perf_counter()
+                    self._m_errors.inc()
+                    self._tel.emit(
+                        "serve.request", order=order,
+                        queue_s=round(now - t_enq, 6) if t_enq else 0.0,
+                        total_s=round(now - t_enq, 6) if t_enq else 0.0,
+                        error=self._done[order]["error"],
+                    )
 
-    def _start(self, order: int, req: dict) -> None:
+    def _start(self, order: int, req: dict, t_enq: float = 0.0) -> None:
         prompt = str(req["prompt"])
         ids = [self.tok.bos_id, *self.tok.encode(prompt)]
         L = len(ids)
@@ -300,6 +374,7 @@ class ContinuousScheduler:
 
         n = prefill_len_for(L, self.prefill_chunk)
         slot = self._free.pop()
+        t_admit = time.perf_counter()
         try:
             logits, self.pool.caches = _slot_prefill(
                 self.params, self.pool.caches, jnp.int32(slot),
@@ -314,6 +389,11 @@ class ContinuousScheduler:
             emitted=[], max_new=max_new,
             key=np.asarray(jax.random.PRNGKey(seed)),
             sample=sample, temperature=temperature, top_k=top_k, top_p=top_p,
+            t_enqueue=t_enq or t_admit, t_admit=t_admit,
+            # Dispatch-time edge: under async dispatch the prefill has been
+            # ENQUEUED here, not finished; the full-prefill path syncs just
+            # below at the first pick, making the span exact there.
+            t_prefill=time.perf_counter(),
         )
         self._active[slot] = st
         self.stats["max_active"] = max(self.stats["max_active"], len(self._active))
@@ -336,6 +416,8 @@ class ContinuousScheduler:
                 raise
             self._consume_pick(slot, st, tokv)
         self.stats["admitted"] += 1
+        if self._tel is not None:
+            self._m_admissions.inc()
 
     # ---- stepping ---------------------------------------------------------
 
@@ -343,7 +425,13 @@ class ContinuousScheduler:
         """Advance every occupied slot one token (ONE pooled forward),
         retire finished slots. No-op when the pool is idle."""
         if not self._active:
+            if self._tel is not None:
+                self._m_active.set(0)
+                self._m_backlog.set(len(self._queue))
+                self._m_ready.set(len(self._done))
+                self._tel.maybe_flush()
             return
+        t_step = time.perf_counter()
         N = self.num_slots
         toks = np.full((N,), PAD_ID, np.int32)
         keys = np.zeros((N, *np.shape(jax.random.PRNGKey(0))), np.uint32)
@@ -376,8 +464,24 @@ class ContinuousScheduler:
             if st.pos < st.prompt_len:
                 st.cur = st.ids[st.pos]  # still consuming the prompt tail
                 continue
+            if st.pos == st.prompt_len and not st.emitted:
+                # Only reachable for a chunked (tail-fed) prompt: the step
+                # that just ran ingested the FINAL prompt token (and its
+                # logits feed the first pick below) — close the prefill span
+                # here so it covers the whole prompt. Full-prefill slots pick
+                # their first token at admission and skip this transition.
+                st.t_prefill = time.perf_counter()
             self._consume_pick(slot, st, picks[slot])
         self.stats["steps"] += 1
+        if self._tel is not None:
+            # The np.asarray(_pick_pool) above was a real device sync, so
+            # this window is genuine step time, not dispatch time.
+            self._m_step_s.observe(time.perf_counter() - t_step)
+            self._m_steps.inc()
+            self._m_active.set(len(self._active))
+            self._m_backlog.set(len(self._queue))
+            self._m_ready.set(len(self._done))
+            self._tel.maybe_flush()
 
     def _consume_pick(self, slot: int, st: _Active, tokv: int) -> None:
         """Apply one generated token: retire on EOS or budget exhaustion,
@@ -388,6 +492,10 @@ class ContinuousScheduler:
             self._finish(slot, st)
             return
         st.emitted.append(tokv)
+        if st.t_first is None:
+            st.t_first = time.perf_counter()
+        if self._tel is not None:
+            self._m_tokens.inc()
         if len(st.emitted) >= st.max_new:
             self._finish(slot, st)
         else:
@@ -402,6 +510,29 @@ class ContinuousScheduler:
         self._done[st.order] = {"continuation": text}
         del self._active[slot]
         self._free.append(slot)
+        if self._tel is not None:
+            now = time.perf_counter()
+            queue_s = st.t_admit - st.t_enqueue
+            total_s = now - st.t_enqueue
+            span = {
+                "order": st.order,
+                "prompt_tokens": st.prompt_len,
+                "new_tokens": len(st.emitted),
+                "queue_s": round(queue_s, 6),
+                "total_s": round(total_s, 6),
+            }
+            self._m_queue_s.observe(queue_s)
+            self._m_total_s.observe(total_s)
+            if st.t_prefill is not None:
+                prefill_s = st.t_prefill - st.t_admit
+                span["prefill_s"] = round(prefill_s, 6)
+                self._m_prefill_s.observe(prefill_s)
+            if st.t_first is not None:
+                ttft_s = st.t_first - st.t_enqueue
+                span["ttft_s"] = round(ttft_s, 6)
+                self._m_ttft_s.observe(ttft_s)
+            self._m_retirements.inc()
+            self._tel.emit("serve.request", **span)
 
     # ---- output -----------------------------------------------------------
 
@@ -423,4 +554,7 @@ class ContinuousScheduler:
         while self.busy:
             self.admit()
             self.step()
-        return self.drain_ready()
+        out = self.drain_ready()
+        if self._tel is not None:
+            self._tel.maybe_flush(force=True)
+        return out

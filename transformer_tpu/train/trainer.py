@@ -125,6 +125,14 @@ def make_train_step(
     accum = max(1, train_cfg.grad_accum_steps)
 
     def _apply(state, grads, metrics):
+        # Pre-clip global gradient norm: the training-health scalar every
+        # telemetry sink exports (docs/OBSERVABILITY.md). Computed here so
+        # the plain and grad-accum paths report the same quantity (the
+        # accum path passes already-normalized whole-batch grads).
+        metrics = {
+            **metrics,
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+        }
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -308,6 +316,11 @@ def make_multistep_train_step(
             out["moe_aux"] = (ms["moe_aux"] * ms["weight"]).sum(0) / jnp.maximum(
                 out["weight"], 1.0
             )
+        if "grad_norm" in ms:
+            # Mean over the K optimizer steps: one representative
+            # training-health scalar per dispatch (guarded — custom step_fns
+            # without the metric stay supported).
+            out["grad_norm"] = ms["grad_norm"].mean(0)
         return state, out
 
     return multistep
@@ -539,6 +552,7 @@ class Trainer:
         donate_state: bool = True,
         log_fn: Callable[[str], None] = print,
         profiler: "Profiler | None" = None,
+        telemetry=None,
     ) -> None:
         self.model_cfg = model_cfg
         self.train_cfg = train_cfg
@@ -557,6 +571,27 @@ class Trainer:
                 "train": SummaryWriter(f"{log_dir}/train"),
                 "test": SummaryWriter(f"{log_dir}/test"),
             }
+        # Telemetry (obs.Telemetry | None): host-side recording at the sync
+        # points the loop already has (log/eval/epoch boundaries) — zero new
+        # device ops, zero recompiles (analysis telemetry_inert contract).
+        self.telemetry = telemetry
+        self._last_metrics: dict | None = None
+        self._window_mark = (0, 0, 0.0)  # (steps, tokens, time) at last record
+        if telemetry is not None:
+            reg = telemetry.registry
+            self._m_loss = reg.gauge("train_loss", "streaming epoch train loss")
+            self._m_acc = reg.gauge("train_accuracy", "streaming token accuracy")
+            self._m_gnorm = reg.gauge("train_grad_norm", "latest global grad norm")
+            self._m_eloss = reg.gauge("train_eval_loss", "latest eval loss")
+            self._m_eacc = reg.gauge("train_eval_accuracy", "latest eval accuracy")
+            self._m_steps = reg.counter("train_steps_total", "optimizer steps")
+            self._m_tokens = reg.counter("train_tokens_total", "target tokens")
+            # Bound to the SAME sample stream StepTimer populates — the
+            # registry exports it, no duplicate quantile accounting.
+            reg.histogram(
+                "train_step_seconds", "per-step wall time (synced windows)",
+                hist=self.step_timer.histogram,
+            )
 
         train_step = make_train_step(model_cfg, train_cfg)
         eval_step = make_eval_step(model_cfg, train_cfg)
@@ -582,6 +617,24 @@ class Trainer:
             eval_step = jax.jit(eval_step)
         self.train_step = train_step
         self.eval_step = eval_step
+        if telemetry is not None:
+            self._wrap_steps_for_dispatch_timing()
+
+    def _wrap_steps_for_dispatch_timing(self) -> None:
+        """Route the step callables through ``obs.telemetry.timed_call`` —
+        the jaxpr-inert wrapper the ``telemetry_inert`` contract pins. Under
+        async dispatch this histogram measures host dispatch latency (a
+        host-stall detector); StepTimer's synced windows stay the
+        device-throughput source of truth. DistributedTrainer re-invokes
+        this after swapping in its sharded steps."""
+        from transformer_tpu.obs.telemetry import timed_call
+
+        self._m_dispatch = self.telemetry.registry.histogram(
+            "train_dispatch_seconds", "host dispatch latency per step call"
+        )
+        self.train_step = timed_call(self.train_step, self._m_dispatch)
+        if self.multi_step is not None:
+            self.multi_step = timed_call(self.multi_step, self._m_dispatch)
 
     # ------------------------------------------------------------------ loop
     def evaluate(
@@ -678,6 +731,7 @@ class Trainer:
             for epoch in range(start_epoch, cfg.epochs):
                 self.train_metrics.reset()
                 self.step_timer.reset()
+                self._window_mark = (0, 0, 0.0)
                 epoch_start = time.time()
                 batch_iter = train_ds.batches(epoch)
                 if self.multi_step is not None:
@@ -699,6 +753,7 @@ class Trainer:
                         self.state, m = self.multi_step(self.state, src, tgt, rng)
                         tokens = k * src.shape[1] * max(tgt.shape[2] - 1, 1)
                     self.train_metrics.update(m)
+                    self._last_metrics = m  # host ref only; read at syncs
                     self.step_timer.tick(tokens, steps=k)
                     prev_step = step
                     step += k
@@ -722,6 +777,7 @@ class Trainer:
                             + (f"moe_aux {aux:.3f} " if aux is not None else "")
                             + f"({self.step_timer.steps_per_sec:.2f} steps/s)"
                         )
+                        self._record_train_window(epoch, step)
                     if (
                         test_ds is not None
                         and cfg.eval_every_steps
@@ -740,6 +796,7 @@ class Trainer:
                             f"  eval loss {self.eval_metrics.loss:.4f} "
                             f"acc {self.eval_metrics.accuracy:.4f}"
                         )
+                        self._record_eval(epoch, step)
 
                 epoch_loss = self.train_metrics.loss  # device_get: blocks
                 self.step_timer.sync()
@@ -751,7 +808,10 @@ class Trainer:
                     if guard.should_stop:
                         self._preempt(step, guard)
                         return
+                    self._record_eval(epoch, step)
                 self._write_epoch_summaries(epoch)
+                self._record_train_window(epoch, step)
+                self._record_epoch_telemetry(epoch, step)
                 self.log_fn(
                     f"epoch {epoch + 1}/{cfg.epochs} done in "
                     f"{time.time() - epoch_start:.1f}s: "
@@ -809,6 +869,102 @@ class Trainer:
             self.checkpoint.wait()
         if self.profiler is not None:
             self.profiler.stop(block_on=self.state)
+        if self.telemetry is not None:
+            self.telemetry.maybe_flush(force=True)
+
+    # ------------------------------------------------------------- telemetry
+    # All recorders run at points where the loop has ALREADY paid a blocking
+    # metric read (train_metrics.loss / eval_metrics.loss device_get) and a
+    # step_timer.sync() — they add host float reads, never device ops.
+
+    def _record_train_window(self, epoch: int, step: int) -> None:
+        if self.telemetry is None:
+            return
+        st = self.step_timer
+        m_steps, m_tokens, m_time = self._window_mark
+        d_steps = st.count - m_steps
+        if d_steps <= 0:
+            return
+        d_tokens = st.total_tokens - m_tokens
+        window_s = st.total_time_s - m_time
+        self._window_mark = (st.count, st.total_tokens, st.total_time_s)
+        loss = self.train_metrics.loss
+        acc = self.train_metrics.accuracy
+        self._m_loss.set(loss)
+        self._m_acc.set(acc)
+        self._m_steps.inc(d_steps)
+        self._m_tokens.inc(d_tokens)
+        event = {
+            "epoch": epoch + 1, "step": step, "steps": d_steps,
+            "tokens": d_tokens, "window_s": round(window_s, 6),
+            "loss": round(loss, 6), "accuracy": round(acc, 6),
+        }
+        if window_s > 0:
+            event["steps_per_sec"] = round(d_steps / window_s, 3)
+            event["tokens_per_sec"] = round(d_tokens / window_s, 1)
+        if self._last_metrics is not None and "grad_norm" in self._last_metrics:
+            gnorm = float(self._last_metrics["grad_norm"])
+            self._m_gnorm.set(gnorm)
+            event["grad_norm"] = round(gnorm, 6)
+        self.telemetry.emit("train.window", **event)
+        self.telemetry.maybe_flush()
+
+    def _record_eval(self, epoch: int, step: int) -> None:
+        if self.telemetry is None or self.eval_metrics.weight <= 0:
+            return
+        loss, acc = self.eval_metrics.loss, self.eval_metrics.accuracy
+        self._m_eloss.set(loss)
+        self._m_eacc.set(acc)
+        self.telemetry.emit(
+            "train.eval", epoch=epoch + 1, step=step,
+            loss=round(loss, 6), accuracy=round(acc, 6),
+        )
+
+    def _record_epoch_telemetry(self, epoch: int, step: int) -> None:
+        """Epoch-boundary extras: device memory stats (where the backend
+        exposes them) and jit compile-cache accounting — recompiles surface
+        as a visible counter, not just a retrace-sentinel test failure."""
+        if self.telemetry is None:
+            return
+        from transformer_tpu.obs import device_memory_stats
+
+        devices = {}
+        for d in jax.local_devices():
+            stats = device_memory_stats(d)
+            if stats:
+                devices[str(d.id)] = stats
+        if devices:
+            first = next(iter(devices.values()))
+            for key in ("bytes_in_use", "peak_bytes_in_use"):
+                if key in first:
+                    self.telemetry.registry.gauge(
+                        f"device_{key}", "PJRT allocator stats, device 0"
+                    ).set(first[key])
+            self.telemetry.emit(
+                "train.memory", epoch=epoch + 1, step=step, devices=devices
+            )
+        cache_sizes = {}
+        # *_fn variants: DistributedTrainer keeps the jitted sharded steps
+        # there (its train_step attribute is a host-side placement wrapper).
+        for name in ("train_step", "multi_step", "eval_step",
+                     "train_step_fn", "multi_step_fn", "eval_step_fn"):
+            fn = getattr(self, name, None)
+            fn = getattr(fn, "__wrapped__", fn)  # through timed_call
+            probe = getattr(fn, "_cache_size", None)
+            if probe is not None:
+                # The same accounting the analysis/retrace.py sentinel
+                # budgets: compiled-program counts per jitted hot path.
+                cache_sizes[name] = int(probe())
+        if cache_sizes:
+            self.telemetry.registry.gauge(
+                "train_compiled_programs",
+                "compiled executables across the jitted step caches",
+            ).set(sum(cache_sizes.values()))
+            self.telemetry.emit(
+                "train.compile", epoch=epoch + 1, step=step,
+                cache_sizes=cache_sizes,
+            )
+        self.telemetry.maybe_flush(force=True)
 
     # ---------------------------------------------------------- plateau state
     # Host-side early-stop accounting, persisted so crash-resume keeps the
@@ -905,6 +1061,11 @@ class Trainer:
             self.log_fn(prefix + "no checkpoint manager configured, state lost")
         for w in self.writers.values():
             w.flush()
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "train.preempt", step=step, signal=guard.signal_received
+            )
+            self.telemetry.maybe_flush(force=True)
 
     def _write_epoch_summaries(self, epoch: int) -> None:
         if not self.writers:
@@ -921,6 +1082,11 @@ class Trainer:
         )
         w.scalar("learning_rate", float(lr), epoch)
         w.scalar("tokens_per_sec", self.step_timer.tokens_per_sec, epoch)
+        if self._last_metrics is not None and "grad_norm" in self._last_metrics:
+            w.scalar("grad_norm", float(self._last_metrics["grad_norm"]), epoch)
+        # Step-duration distribution (p50/p95/p99 in TensorBoard's histogram
+        # dashboard) — the tfevents face of the obs step-time histogram.
+        w.histogram("step_time_s", self.step_timer.histogram, epoch)
         w.flush()
         if self.eval_metrics.weight > 0:
             w = self.writers["test"]

@@ -24,6 +24,8 @@ import time
 
 import jax
 
+from transformer_tpu.obs.quantiles import StreamingHistogram
+
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str:
     """Persist compiled executables across processes.
@@ -124,6 +126,14 @@ class StepTimer:
 
     def __init__(self, tokens_per_step: int = 0):
         self.tokens_per_step = tokens_per_step
+        # Online step-duration distribution (p50/p95/p99), fed one window at
+        # a time by sync(). The histogram instance is the obs-registry reuse
+        # point: Trainer binds it into a registry Histogram
+        # (`registry.histogram(name, hist=timer.histogram)`), so telemetry
+        # exports the SAME sample stream with no duplicate quantile code.
+        # Survives reset(): reset() reopens the throughput window per epoch,
+        # but the duration distribution is a run-level statistic.
+        self.histogram = StreamingHistogram()
         self.reset()
 
     def reset(self) -> None:
@@ -153,9 +163,14 @@ class StepTimer:
         of step outputs, so the elapsed time covers completed device work."""
         if self._window_start is None or self._window_steps == 0:
             return
-        self._total_time += time.perf_counter() - self._window_start
+        window = time.perf_counter() - self._window_start
+        self._total_time += window
         self._total_steps += self._window_steps
         self._total_tokens += self._window_tokens
+        # Per-step duration is only observable at window granularity under
+        # async dispatch: attribute the window's wall time evenly to the
+        # steps inside it (n identical samples keeps step-count weighting).
+        self.histogram.observe(window / self._window_steps, n=self._window_steps)
         self._window_steps = 0
         self._window_tokens = 0
         self._window_start = None
@@ -163,6 +178,14 @@ class StepTimer:
     @property
     def count(self) -> int:
         return self._total_steps
+
+    @property
+    def total_tokens(self) -> int:
+        return self._total_tokens
+
+    @property
+    def total_time_s(self) -> float:
+        return self._total_time
 
     @property
     def mean_s(self) -> float:
@@ -185,4 +208,11 @@ class StepTimer:
         )
         if self._total_tokens:
             msg += f", {self.tokens_per_sec:,.0f} tokens/s"
-        return msg + ")"
+        msg += ")"
+        if self.histogram.count:
+            p = self.histogram.percentiles()
+            msg += (
+                f" p50 {p['p50'] * 1e3:.1f}ms p95 {p['p95'] * 1e3:.1f}ms "
+                f"p99 {p['p99'] * 1e3:.1f}ms"
+            )
+        return msg
