@@ -40,6 +40,15 @@ def main() -> None:
     p.add_argument("--decode_steps", type=int, default=32)
     p.add_argument("--chunk", type=int, default=0,
                    help="prefill chunk size (0 = whole prompt in one forward)")
+    p.add_argument("--speculate_k", type=str, default="",
+                   help="comma-separated speculative lookahead sweep (e.g. "
+                        "'2,4'): per k, decode batch-1 speculatively with "
+                        "the n-gram drafter and report tokens/s, "
+                        "tokens-per-forward, and draft acceptance rate")
+    p.add_argument("--rows_out", type=str, default="",
+                   help="append bench_rows.jsonl-compatible rows for the "
+                        "--speculate_k sweep to this file ('' = print them "
+                        "to stderr; stdout stays one summary JSON line)")
     p.add_argument("--reps", type=int, default=5,
                    help="timed repetitions (best-of is reported)")
     p.add_argument("--layers", type=int, default=2)
@@ -137,6 +146,46 @@ def main() -> None:
     decode_steps_s = args.decode_steps / best_dec
     decode_tok_s = args.batch * args.decode_steps / best_dec
 
+    # ---- speculative decoding sweep (batch-1, n-gram drafter) -------------
+    # Headline: tokens emitted per target-model VERIFY forward — the number
+    # speculation exists to push past 1.0 (incremental decode's ceiling).
+    # The prompt tiles a short motif so prompt-lookup drafting has honest
+    # traction (the repetitive-text regime it is built for).
+    speculative = []
+    ks = [int(x) for x in args.speculate_k.split(",") if x.strip()]
+    if ks:
+        from transformer_tpu.serve.speculative import (
+            NgramDrafter,
+            speculative_generate,
+        )
+
+        motif = rng.integers(1, args.vocab - 2, 8)
+        spec_prompt = [int(motif[i % 8]) for i in range(args.prompt_len)]
+        for k in ks:
+            if k < 1:
+                continue
+            stats = {}
+            toks: list = []
+            best_spec = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                toks, stats = speculative_generate(
+                    params, cfg, spec_prompt, args.decode_steps, eos_id=-1,
+                    speculate_k=k, drafter=NgramDrafter(),
+                    prefill_chunk=args.chunk,
+                )
+                best_spec = min(best_spec, time.perf_counter() - t0)
+            tpf = len(toks) / max(stats["verify_forwards"], 1)
+            acc = stats["accepted"] / max(stats["drafted"], 1)
+            speculative.append({
+                "k": k,
+                "tokens_per_sec": round(len(toks) / best_spec, 1),
+                "tokens_per_forward": round(tpf, 3),
+                "acceptance_rate": round(acc, 4),
+                "verify_forwards": stats["verify_forwards"],
+                "new_tokens": len(toks),
+            })
+
     print(json.dumps({
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "decode_tokens_per_sec": round(decode_tok_s, 1),
@@ -148,7 +197,37 @@ def main() -> None:
         "decode_steps": args.decode_steps,
         "chunk": args.chunk,
         "device": f"{dev.platform}:{dev.device_kind}",
+        **({"speculative": speculative} if speculative else {}),
     }))
+
+    if speculative:
+        # bench_rows.jsonl-compatible rows: one per sweep point, so rounds
+        # can diff speculative throughput like any other bench metric.
+        rows = [
+            json.dumps({
+                "metric": "speculative decode tokens-per-forward",
+                "value": s["tokens_per_forward"],
+                "unit": "tokens/forward",
+                "config": {
+                    "layers": args.layers, "d_model": args.d_model,
+                    "heads": args.heads, "dff": args.dff,
+                    "prompt_len": args.prompt_len,
+                    "decode_steps": args.decode_steps,
+                    "speculate_k": s["k"], "drafter": "ngram",
+                },
+                "tokens_per_sec": s["tokens_per_sec"],
+                "acceptance_rate": s["acceptance_rate"],
+                "device": f"{dev.platform}:{dev.device_kind}",
+                "vs_baseline": None,
+            })
+            for s in speculative
+        ]
+        if args.rows_out:
+            with open(args.rows_out, "a", encoding="utf-8") as f:
+                f.write("\n".join(rows) + "\n")
+        else:
+            for row in rows:
+                print(row, file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -204,6 +204,49 @@ class TestLengthBuckets:
             rows += (src != 0).any(axis=1).sum()
         assert rows == 10
 
+    def test_prefetch_fallback_bit_identical_order(self):
+        """Without the native loader, prefetch=True falls back to a Python
+        background-thread double-buffer (jax.device_put one batch ahead)
+        with a warning — never a hard error — and the batch stream is
+        bit-identical to the prefetch=False Python path, flat AND bucketed,
+        single-host AND sharded (formerly a multi-host RuntimeError)."""
+        import warnings
+
+        for kw in (
+            dict(),
+            dict(shuffle=False),
+            dict(shard_index=1, shard_count=2),
+        ):
+            plain = self._mk(n=10, batch=4, drop_remainder=False, **kw)
+            pre = self._mk(
+                n=10, batch=4, drop_remainder=False, prefetch=True, **kw
+            )
+            pre._native = False  # force "native loader unavailable"
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                got = list(pre.batches(2))
+            assert any(
+                "double-buffer" in str(w.message) for w in caught
+            ), [str(w.message) for w in caught]
+            want = list(plain.batches(2))
+            assert len(got) == len(want) > 0
+            for (a, b), (c, d) in zip(got, want):
+                np.testing.assert_array_equal(np.asarray(a), c)
+                np.testing.assert_array_equal(np.asarray(b), d)
+
+    def test_prefetch_fallback_early_break_does_not_hang(self):
+        """Abandoning the fallback iterator mid-epoch must not deadlock on
+        the bounded queue (the worker notices and exits)."""
+        import warnings
+
+        pre = self._mk(n=16, batch=4, prefetch=True)
+        pre._native = False
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i, _ in enumerate(pre.batches(0)):
+                if i == 1:
+                    break  # worker must not block forever on q.put
+
     def test_overlong_examples_rejected_not_clamped(self):
         """A largest bucket narrower than the data must fail loudly — silent
         clamping would truncate sentences (and their EOS) mid-stream."""

@@ -15,6 +15,11 @@ Contracts:
   variant (plain bf16, int8+scales, rolling window, GQA). A drift here is
   the classic silent serving bug: the slot pool admits via prefill but
   steps incrementally, so a mismatch poisons every request after the first.
+- **verify_cache_parity** — a speculative verify forward (one S_q = k+1
+  call through ``transformer_verify``) must leave caches structurally
+  indistinguishable from k+1 repeated incremental steps, and return
+  per-position logits — the speculative scheduler interleaves the two
+  paths (plus index rollback) over one slot pool.
 - **softmax_f32** — ``dot_product_attention`` promises its softmax runs in
   fp32 even under bf16 compute (``ops/attention.py``); checked by walking
   the jaxpr of the forward for ``exp`` equations and asserting their
@@ -172,6 +177,59 @@ def check_cache_parity(cfg: ModelConfig, batch: int = 2, n: int = 4) -> str:
         f"cache carries {kv_heads} kv heads, config says {cfg.kv_heads}"
     )
     return f"{len(a)} cache leaves identical across prefill/step"
+
+
+def check_verify_cache_parity(cfg: ModelConfig, batch: int = 2, k: int = 3) -> str:
+    """One speculative verify forward (S_q = k + 1 through
+    ``transformer_verify``) and ``k + 1`` repeated incremental steps must
+    leave caches with identical pytree structure, shapes, AND dtypes — the
+    speculative scheduler interleaves verify forwards, single-token steps,
+    and index rollback over ONE slot pool, so any layout drift between the
+    paths poisons every request that follows a mixed step. Verify must
+    also return per-position logits (B, k + 1, V) whose dtype matches the
+    step path's — the acceptance rule compares them position by position."""
+    from transformer_tpu.models.decoder import init_decoder_caches
+    from transformer_tpu.models.transformer import (
+        transformer_decode_step,
+        transformer_verify,
+    )
+
+    total = 16
+    params = abstract_params(cfg)
+
+    def verify_path(params, tokens):
+        caches = init_decoder_caches(cfg, batch, total)
+        return transformer_verify(params, tokens, caches, 0, cfg)
+
+    def step_path(params, tokens):
+        caches = init_decoder_caches(cfg, batch, total)
+        logits = None
+        for i in range(k + 1):
+            logits, caches = transformer_decode_step(
+                params, tokens[:, i : i + 1], None, None, caches, i, cfg
+            )
+        return logits, caches
+
+    tokens = _ids(batch, k + 1)
+    v_logits, via_verify = jax.eval_shape(verify_path, params, tokens)
+    s_logits, via_steps = jax.eval_shape(step_path, params, tokens)
+    a, b = _tree_spec(via_verify), _tree_spec(via_steps)
+    assert a == b, (
+        "speculative verify and repeated incremental steps disagree on "
+        f"cache layout/dtype:\n  verify: {a}\n  steps:  {b}"
+    )
+    want = (batch, k + 1, cfg.target_vocab_size)
+    assert v_logits.shape == want, (
+        f"verify logits are {v_logits.shape}, want per-position {want}"
+    )
+    assert v_logits.dtype == s_logits.dtype, (
+        f"verify logits dtype {v_logits.dtype} != step logits dtype "
+        f"{s_logits.dtype} — the acceptance comparison would mix dtypes"
+    )
+    return (
+        f"{len(a)} cache leaves identical across verify/{k + 1} steps; "
+        f"logits {want} {v_logits.dtype}"
+    )
 
 
 def _walk_eqns(jaxpr) -> Iterable:
@@ -433,6 +491,10 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
 
 _CONTRACTS: list[tuple[str, Callable[[ModelConfig], str], Callable[[ModelConfig], bool]]] = [
     ("cache_parity", check_cache_parity, lambda c: not c.encoder_only),
+    # Speculation serves the LM path only; the structural parity still
+    # covers every cache variant (plain/int8/rolling/GQA) — rolling caches
+    # can't ROLL BACK, but their verify writes must still match steps.
+    ("verify_cache_parity", check_verify_cache_parity, lambda c: c.decoder_only),
     ("softmax_f32", check_softmax_f32, lambda c: True),
     ("residual_dtype", check_residual_dtype, lambda c: True),
     ("mask_broadcast", check_mask_broadcast, lambda c: True),

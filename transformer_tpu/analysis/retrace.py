@@ -176,6 +176,51 @@ def decode_retrace_report(steps: int = 3) -> list[WatchDelta]:
     return sentinel.deltas()
 
 
+def speculative_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state SPECULATIVE serving: accept lengths vary per request
+    (a self-repeating prompt lands long n-gram accepts; an irregular one
+    mostly misses), yet the hot paths — ``_pool_verify`` (the W-wide
+    verify forward), ``_pick_pool_verify``, ``_slot_prefill``, and
+    ``_pool_rollback`` — must compile ZERO new programs after warmup:
+    rows are padded to the static width k + 1 and rollback is index
+    arithmetic, so no accept length may mint a fresh shape."""
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg, params, tok = _tiny_lm_setup()
+
+    # Mixed acceptance shapes on purpose: repetitive text drafts well,
+    # irregular text rejects early, short prompts exercise the boundary.
+    waves = [
+        [{"prompt": "the quick brown fox"}, {"prompt": "dog dog dog dog"}],
+        [{"prompt": "the the the the the"}, {"prompt": "lazy fox"}],
+        [{"prompt": "quick quick brown"}, {"prompt": "the lazy dog"}],
+    ]
+
+    def serve(reqs):
+        s = ContinuousScheduler(
+            params, cfg, tok, num_slots=2, max_total=32, default_max_new=6,
+            speculate_k=3,
+        )
+        return s.run(reqs)
+
+    for wave in waves:
+        # Warmup covers every prefill bucket the waves touch: bucketed
+        # prefill widths (prefill_len_for) are a bounded compile set, not
+        # steady-state retraces — the budget guards the per-STEP paths.
+        serve([dict(r) for r in wave])
+    sentinel = RetraceSentinel()
+    sentinel.watch("verify(_pool_verify)", sched._pool_verify, budget=0)
+    sentinel.watch("pick(_pick_pool_verify)", sched._pick_pool_verify, budget=0)
+    sentinel.watch("_slot_prefill", sched._slot_prefill, budget=0)
+    sentinel.watch("rollback(_pool_rollback)", sched._pool_rollback, budget=0)
+    sentinel.snapshot()
+    for i in range(steps):
+        out = serve([dict(r) for r in waves[i % len(waves)]])
+        assert all("continuation" in r for r in out), out
+    return sentinel.deltas()
+
+
 def train_retrace_report(steps: int = 3) -> list[WatchDelta]:
     """Steady-state training: one warmup step compiles; ``steps`` more
     same-shaped steps must not."""

@@ -36,7 +36,12 @@ one token per tick, retiring finished requests and admitting queued ones
 mid-flight via single-pass chunked prefill (``--prefill_chunk``) — a
 straggler with a long generation no longer holds a whole batch's chip time
 hostage. ``--serve_slots=0`` restores the grouped decode-to-completion
-path. See docs/SERVING.md.
+path. ``--speculate_k`` adds speculative decoding on the same slot pool:
+a drafter (``--draft_checkpoint`` model or the default n-gram
+prompt-lookup, ``--draft_ngram``) proposes candidate tokens and one
+multi-token verify forward scores them all — more tokens per
+bandwidth-bound forward, byte-identical greedy answers. See
+docs/SERVING.md.
 
 Telemetry: ``--metrics_jsonl`` streams structured events (per-request spans,
 slot utilization) + periodic metric snapshots, and ``--metrics_port`` serves
@@ -82,6 +87,23 @@ def define_serve_flags() -> None:
         "split prompt prefill into chunks of this many tokens so activation "
         "memory stays bounded at long prompt lengths (0 = whole prompt in "
         "one forward); also used by grouped-path generate()")
+    flags.DEFINE_integer(
+        "speculate_k", 0,
+        "speculative decoding lookahead for the continuous-batching path: "
+        "a drafter proposes up to this many candidate tokens per step and "
+        "one multi-token verify forward scores them all (greedy answers "
+        "stay byte-identical; sampled requests use rejection-sampling "
+        "acceptance). 0 = off. Incompatible with attention_window "
+        "(rolling caches cannot roll back)")
+    flags.DEFINE_string(
+        "draft_checkpoint", "",
+        "export directory of a small draft model SHARING the target "
+        "tokenizer, used as the speculative drafter ('' = the model-free "
+        "n-gram prompt-lookup drafter)")
+    flags.DEFINE_integer(
+        "draft_ngram", 3,
+        "longest suffix n-gram the model-free drafter matches against "
+        "earlier context (only used when --draft_checkpoint is unset)")
 
 
 def _parse_line(line: str, model_cfg) -> dict:
@@ -363,8 +385,16 @@ def main(argv) -> None:
     q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_batch) * 8)
     threading.Thread(target=_stdin_reader, args=(q,), daemon=True).start()
     if continuous:
-        from transformer_tpu.serve import ContinuousScheduler
+        from transformer_tpu.serve import ContinuousScheduler, drafter_from_flags
 
+        drafter = None
+        if FLAGS.speculate_k > 0:
+            drafter = drafter_from_flags(
+                FLAGS.draft_checkpoint, FLAGS.draft_ngram,
+                FLAGS.serve_max_total or model_cfg.max_position + 1,
+                eos_id=tgt_tok.eos_id,
+                target_vocab_size=model_cfg.target_vocab_size,
+            )
         sched = ContinuousScheduler(
             params, model_cfg, tgt_tok,
             num_slots=FLAGS.serve_slots,
@@ -372,6 +402,8 @@ def main(argv) -> None:
             prefill_chunk=FLAGS.prefill_chunk,
             default_max_new=FLAGS.max_len,
             telemetry=telemetry,
+            speculate_k=FLAGS.speculate_k,
+            drafter=drafter,
         )
         serve_continuous(q, sched, model_cfg, telemetry=telemetry)
         if telemetry is not None:

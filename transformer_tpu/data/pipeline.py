@@ -102,6 +102,68 @@ def _encode_and_frame(
     ]
 
 
+def _threaded_device_prefetch(
+    it: Iterator[tuple[np.ndarray, np.ndarray]], depth: int = 2
+) -> Iterator:
+    """Python fallback for ``prefetch=True`` without the native loader:
+    a background thread assembles batches and ``jax.device_put``s them up
+    to ``depth`` ahead, so host-side batch assembly and H2D transfer
+    overlap with device steps instead of serializing with them. Yields
+    batches in EXACTLY the source iterator's order (bit-identical to the
+    ``prefetch=False`` path — pinned by test); exceptions in the worker
+    re-raise at the consumer."""
+    import queue
+    import threading
+
+    import jax
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    failure: list[BaseException] = []
+    sentinel = object()
+
+    def worker() -> None:
+        try:
+            for item in it:
+                payload = jax.device_put(item)
+                # Bounded put that gives up if the consumer went away
+                # (early break / generator close): a daemon thread parked
+                # forever on a full queue would pin the batch buffers.
+                while not stop.is_set():
+                    try:
+                        q.put(payload, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001  # tpa: disable=TPA006 — cross-thread reraise: the worker forwards EVERY failure to the consumer thread, which re-raises it; swallowing here would hang the consumer on a silent EOF instead
+            failure.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    thread = threading.Thread(
+        target=worker, name="pipeline-prefetch", daemon=True
+    )
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        thread.join()
+        if failure:
+            raise failure[0]
+    finally:
+        stop.set()
+
+
 @dataclasses.dataclass
 class Seq2SeqDataset:
     """In-memory parallel dataset yielding fixed-shape (B, L) int32 batches.
@@ -218,26 +280,28 @@ class Seq2SeqDataset:
                 seed = (self.seed * 0x9E3779B97F4A7C15 + epoch) & (2**64 - 1)
                 yield from loader.epoch(seed, self.shuffle, self.drop_remainder)
                 return
-            if self.shard_count > 1:
-                # The native and Python paths shuffle with different PRNGs; a
-                # host silently falling back would slice a DIFFERENT global
-                # permutation than its peers — batch corruption, not a slow
-                # path. Refuse instead.
-                raise RuntimeError(
-                    "prefetch requested but the native loader is unavailable "
-                    "on this host; with multi-host sharding a silent Python "
-                    "fallback would desynchronize the global shuffle. Build "
-                    "transformer_tpu/native (needs a C++ toolchain) or pass "
-                    "prefetch=False everywhere."
-                )
             import warnings
 
             warnings.warn(
                 "prefetch requested but the native loader is unavailable; "
-                "falling back to the Python batcher (different shuffle order)",
+                "falling back to a Python background-thread double-buffer "
+                "(jax.device_put one batch ahead). Batch order matches the "
+                "prefetch=False Python path bit for bit — which differs "
+                "from the native loader's shuffle, so with multi-host "
+                "sharding EVERY host must take the same path (all native "
+                "or all fallback) or the global shuffle desynchronizes",
                 RuntimeWarning,
                 stacklevel=2,
             )
+            yield from _threaded_device_prefetch(self._python_batches(epoch))
+            return
+        yield from self._python_batches(epoch)
+
+    def _python_batches(
+        self, epoch: int
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """The in-memory Python batcher (bucketed or flat) — the order
+        oracle every other path is pinned against."""
         if self.length_buckets:
             yield from self._bucketed_batches(epoch)
             return

@@ -21,7 +21,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def keyed_rng(*key: int) -> np.random.Generator:
+    """A deterministic PRNG keyed on an integer tuple (SeedSequence mixes
+    the components, so (0, 1) and (1, 0) land in unrelated streams). The
+    ONE place the tuple-keyed ``default_rng`` construction lives — the
+    epoch shuffle below and the speculative-decoding acceptance draws
+    (``serve/speculative.py``: keyed on (request seed, absolute position),
+    so accept/reject decisions are reproducible per position) both route
+    through it."""
+    return np.random.default_rng(key)
+
+
 def epoch_rng(seed: int, epoch: int) -> np.random.Generator:
     """The framework-wide epoch-shuffle PRNG: Philox via ``default_rng``
     keyed on ``(seed, epoch)``."""
-    return np.random.default_rng((seed, epoch))
+    return keyed_rng(seed, epoch)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from transformer_tpu.config import ModelConfig
 from transformer_tpu.ops.attention import mha_apply, mha_init
@@ -182,8 +183,19 @@ def embed_prologue(
     x = embedding_lookup(embedding, ids, cfg.compute_dtype)
     x = x * jnp.asarray(cfg.d_model**0.5, dtype=cfg.compute_dtype)
     if cfg.position_scheme == "sinusoidal":
+        # TRACED offsets (KV-cache decode, incl. speculative verify) get
+        # seq_len rows of slack beyond max_position: a verify row whose
+        # lookahead tokens straddle the position budget must NOT trigger
+        # dynamic_slice's start-clamping, which would silently shift the
+        # positions of the row's in-budget tokens (whose picks ARE
+        # consumed). Static offsets (training and prefill forwards — the
+        # wide, constant-heavy programs) provably stay in-bounds, so they
+        # keep the exact max_position table instead of constant-folding an
+        # up-to-2x-larger one into every compiled program. The sinusoid is
+        # computed, so in-range rows are identical either way.
+        slack = 0 if isinstance(position_offset, (int, np.integer)) else seq_len
         table = sinusoidal_positional_encoding(
-            cfg.max_position, cfg.d_model, cfg.compute_dtype
+            cfg.max_position + slack, cfg.d_model, cfg.compute_dtype
         )
         pos = jax.lax.dynamic_slice_in_dim(table, position_offset, seq_len, axis=0)
         x = x + pos[None, :, :]

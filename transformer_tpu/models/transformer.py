@@ -177,6 +177,38 @@ def transformer_prefill(
     return _logits(params, x_last[:, None, :], cfg)[:, -1, :], new_caches
 
 
+def transformer_verify(
+    params: Params,
+    tokens: jax.Array,
+    caches: list[dict[str, Any]],
+    position: jax.Array | int,
+    cfg: ModelConfig,
+) -> tuple[jax.Array, list[dict[str, Any]]]:
+    """Speculative-decoding verify forward: (B, W) candidate tokens at
+    absolute positions ``position .. position + W - 1`` -> ((B, W, vocab)
+    logits for EVERY fed position, updated caches).
+
+    The multi-token sibling of ``transformer_decode_step`` built on the same
+    S_q > 1 cache-write path ``transformer_prefill`` uses (offset causal
+    mask from ``ops/masks.py``): one matmul-rich forward scores a drafter's
+    ``k`` proposals plus the bonus position, instead of ``k + 1``
+    bandwidth-bound single-token steps. Where prefill projects only the
+    last position (prompt logits are never needed), verify projects ALL
+    positions — ``logits[:, j]`` is the next-token distribution after the
+    prefix extended by ``tokens[:, :j+1]``, which is exactly what the
+    acceptance rule compares against ``tokens[:, j+1]``. W stays small
+    (k + 1), so the (B, W, V) tensor never approaches the (B, S, V)
+    materialization the chunked-loss path avoids. Rejected candidates roll
+    back with ``ops.attention.rollback_cache`` (decoder-only: speculation
+    targets the LM serving path)."""
+    x, _, new_caches = decoder_apply(
+        params["decoder"], tokens, None, None, None, cfg,
+        rng=None, deterministic=True, caches=caches,
+        position_offset=position,
+    )
+    return _logits(params, x, cfg), new_caches
+
+
 def transformer_decode_step(
     params: Params,
     token: jax.Array,

@@ -62,6 +62,32 @@ def summarize_events(events: list[dict]) -> dict:
                 round(gen_tokens / busy_s, 2) if busy_s > 0 else None
             ),
         }
+        # Speculative decoding: tokens per target-model decode forward
+        # (the number speculation exists to raise past 1.0) and draft
+        # acceptance. Spans carry "forwards" whenever the scheduler
+        # recorded them, so tokens-per-forward is comparable with
+        # speculation on OR off.
+        forwards = sum(int(r.get("forwards", 0)) for r in ok)
+        if forwards:
+            report["serve"]["tokens_per_forward"] = round(
+                gen_tokens / forwards, 3
+            )
+        drafted = sum(int(r.get("drafted", 0)) for r in ok)
+        if drafted:
+            accepted = sum(int(r.get("draft_accepted", 0)) for r in ok)
+            rate_h = StreamingHistogram()
+            for r in ok:
+                d = int(r.get("drafted", 0))
+                if d > 0:
+                    rate_h.observe(int(r.get("draft_accepted", 0)) / d)
+            report["serve"]["speculative"] = {
+                "drafted": drafted,
+                "accepted": accepted,
+                "acceptance_rate": round(accepted / drafted, 4),
+                # Per-request acceptance-rate spread (p50/p95/... over
+                # requests that drafted at least once).
+                "request_acceptance": rate_h.snapshot(),
+            }
 
     # ---- serve: grouped-path batches --------------------------------------
     batches = [e for e in events if e.get("kind") == "serve.batch"]
@@ -167,6 +193,20 @@ def render_text(report: dict) -> str:
             lines.append(
                 f"  decode rate: {serve['tokens_per_request_second']} "
                 "tokens/s per in-flight request"
+            )
+        if serve.get("tokens_per_forward"):
+            lines.append(
+                f"  tokens/forward: {serve['tokens_per_forward']}"
+            )
+        spec = serve.get("speculative")
+        if spec:
+            q = spec.get("request_acceptance") or {}
+            spread = (
+                f" (per-request p50 {q['p50'] * 100:.0f}%)" if q else ""
+            )
+            lines.append(
+                f"  speculative: {spec['accepted']}/{spec['drafted']} drafts "
+                f"accepted ({spec['acceptance_rate'] * 100:.1f}%){spread}"
             )
         for field, label in (
             ("queue_s", "queue"), ("prefill_s", "prefill"),

@@ -450,6 +450,30 @@ def _store_kv(cache, k, v, write):
     return new, k, v
 
 
+def rollback_cache(cache: dict[str, Any], index) -> dict[str, Any]:
+    """O(1) KV rollback: keep the buffers, reset ``index`` to an earlier
+    position. The speculative-decoding verify step writes K/V for every
+    candidate token it scores; rejected candidates are "erased" by moving
+    the index back — their stale rows stay in the buffer but the offset
+    causal mask (``make_cache_prefix_mask``) already hides every position
+    ``>= index`` from all later reads, and the next real write overwrites
+    them in place (the int8 variant re-quantizes the row, so stale scales
+    can never pair with fresh codes).
+
+    Rolling-window caches are REJECTED: a speculative write at position
+    ``p`` evicts slot ``p % buf_len`` — a position that may still be inside
+    the window after rollback — so index reset cannot restore their state.
+    Gate speculation off for ``attention_window`` configs instead.
+    """
+    if "rolling" in cache:
+        raise ValueError(
+            "rollback_cache cannot restore a rolling-window cache: "
+            "speculative writes evict slots that remain in-window after "
+            "rollback (disable speculation for attention_window configs)"
+        )
+    return dict(cache, index=jnp.asarray(index, jnp.int32))
+
+
 def init_cache(
     batch_size: int,
     max_len: int,

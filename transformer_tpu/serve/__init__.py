@@ -1,6 +1,21 @@
 """Serving-side scheduling: continuous (in-flight) batching over a fixed
-pool of KV-cache slots (``transformer_tpu/serve/scheduler.py``)."""
+pool of KV-cache slots (``transformer_tpu/serve/scheduler.py``) and
+speculative decoding — draft/verify/rollback on that pool
+(``transformer_tpu/serve/speculative.py``)."""
 
 from transformer_tpu.serve.scheduler import ContinuousScheduler, SlotPool
+from transformer_tpu.serve.speculative import (
+    ModelDrafter,
+    NgramDrafter,
+    drafter_from_flags,
+    speculative_generate,
+)
 
-__all__ = ["ContinuousScheduler", "SlotPool"]
+__all__ = [
+    "ContinuousScheduler",
+    "SlotPool",
+    "ModelDrafter",
+    "NgramDrafter",
+    "drafter_from_flags",
+    "speculative_generate",
+]

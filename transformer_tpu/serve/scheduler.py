@@ -22,6 +22,15 @@ pool of KV-cache slots:
 - **Retirement at step boundaries**: a slot that emits EOS (or exhausts its
   ``max_new`` budget) is retired and recycled at the next step boundary; the
   remaining slots never wait for it.
+- **Speculative decoding** (``speculate_k > 0``, ``serve/speculative.py``):
+  each step becomes a verify step — every occupied slot feeds its pending
+  token plus up to ``k`` lookahead tokens (un-ingested prompt tail first,
+  then drafter proposals) through ONE static-width ``_pool_verify``
+  forward; the accepted prefix is kept and the rejected tail is erased by
+  O(1) index rollback (``_pool_rollback``). Greedy answers stay
+  byte-identical; mixed speculative/non-speculative slots share the one
+  compiled program. Refused for rolling-window caches (eviction defeats
+  rollback).
 
 Outputs are bit-identical to ``serve_batch=1`` sequential serving (each
 request alone through ``train.decode.generate``): the per-slot decode is the
@@ -54,10 +63,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from transformer_tpu.config import PAD_ID, ModelConfig
+from transformer_tpu.data.seeding import keyed_rng
 from transformer_tpu.models.decoder import init_decoder_caches
 from transformer_tpu.models.transformer import (
     transformer_decode_step,
     transformer_prefill,
+    transformer_verify,
+)
+from transformer_tpu.serve.speculative import (
+    NgramDrafter,
+    build_verify_row,
+    filtered_probs,
+    judge_row,
+    sampled_accept,
+    verify_row_picks,
 )
 from transformer_tpu.train.decode import (
     _detokenize_rows,
@@ -82,6 +101,41 @@ def _pool_step(params, pool_caches, toks, cfg: ModelConfig):
         return logits[0], caches
 
     return jax.vmap(one)(toks, pool_caches)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _pool_verify(params, pool_caches, toks, cfg: ModelConfig):
+    """One speculative VERIFY step for every slot: (N, W) candidate rows ->
+    ((N, W, V) logits — one distribution per fed position — and updated
+    pool caches). The W-wide sibling of ``_pool_step``, vmapping
+    ``transformer_verify`` (the chunked-prefill S_q > 1 cache-write path)
+    over the slot axis. Every slot feeds a full static-W row — occupied
+    slots pad short rows with PAD lookahead, free slots feed all-PAD — so
+    mixed speculative/non-speculative pools run ONE fixed-shape program.
+    Each slot's index advances by W inside; the host decides per-slot
+    acceptance and rolls back via ``_pool_rollback``."""
+
+    def one(tok_row, caches):
+        pos = caches[0]["index"]
+        logits, caches = transformer_verify(
+            params, tok_row[None, :], caches, pos, cfg
+        )
+        return logits[0], caches
+
+    return jax.vmap(one)(toks, pool_caches)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _pool_rollback(pool_caches, delta):
+    """O(1) speculative rollback over the whole pool: add ``delta`` (N,)
+    — ``accepted_width - W``, zero for free slots — to every layer's cache
+    index. Stale K/V beyond the restored index stay in the buffers but the
+    offset causal mask already hides positions ``>= index`` from all later
+    reads, and the next real write overwrites them in place (the same
+    invariant ``ops.attention.rollback_cache`` documents; the pool variant
+    is arithmetic on the stacked index vector so it stays ONE jitted
+    program)."""
+    return [dict(c, index=c["index"] + delta) for c in pool_caches]
 
 
 @partial(jax.jit, static_argnames=("cfg", "chunk"))
@@ -126,6 +180,26 @@ def _pick_pool(logits, base_keys, positions, temperatures, *, sample, top_k, top
 
 
 @partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))
+def _pick_pool_verify(
+    logits, base_keys, positions, temperatures, *, sample, top_k, top_p
+):
+    """Per-slot, per-position picks over a verify step's (N, W, V) logits
+    -> (N, W) tokens: ``speculative.verify_row_picks`` (the ONE definition
+    of the position-keyed verify-pick math — ``fold_in(base_key, position
+    + j)``, same folding as ``_pick_pool``/``lm_generate``) vmapped over
+    the slot axis, so a slot whose drafts all miss still draws exactly
+    what sequential serving would draw at each absolute position."""
+
+    def one(row_logits, base_key, position, temperature):
+        return verify_row_picks(
+            row_logits, base_key, position, temperature,
+            sample=sample, top_k=top_k, top_p=top_p,
+        )
+
+    return jax.vmap(one)(logits, base_keys, positions, temperatures)
+
+
+@partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))
 def _pick_one(logits, base_key, position, temperature, *, sample, top_k, top_p):
     """Single-row pick for the prefill edge (prompt fully ingested — the
     prefill's last logits are the first generation tick's logits)."""
@@ -152,6 +226,17 @@ class _Active:
     temperature: float
     top_k: int
     top_p: float
+    seed: int = 0              # raw seed (rejection-sampling acceptance rng)
+    # Speculative decoding (scheduler-level k > 0): whether THIS request
+    # drafts (per-request "speculate": false opts out — it still rides the
+    # W-wide verify step, just with no lookahead candidates), the drafter's
+    # per-request state, and the accounting behind acceptance-rate /
+    # tokens-per-forward telemetry.
+    spec: bool = False
+    dstate: object = None
+    drafted: int = 0
+    accepted: int = 0
+    forwards: int = 0          # target-model decode forwards this request rode
     # Span clock (host perf_counter; None until the edge is reached):
     # enqueue -> admit -> prefill-dispatched -> first token -> finish.
     t_enqueue: float = 0.0
@@ -200,17 +285,38 @@ class ContinuousScheduler:
         prefill_chunk: int = 0,
         default_max_new: int = 64,
         telemetry=None,
+        speculate_k: int = 0,
+        drafter=None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
                 "continuous batching serves decoder-only LM exports; seq2seq "
                 "and fill-mask requests go through the grouped path"
             )
+        if speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {speculate_k}")
+        if speculate_k and cfg.attention_window:
+            raise ValueError(
+                "speculative decoding cannot roll back a rolling-window "
+                "cache (attention_window evicts slots that stay in-window "
+                "after rollback); serve this config with speculate_k=0"
+            )
         self.params, self.cfg, self.tok = params, cfg, tokenizer
         self.prefill_chunk = prefill_chunk
         self.default_max_new = default_max_new
         self.max_total = max_total or cfg.max_position + 1
-        self.pool = SlotPool(cfg, num_slots, self.max_total)
+        self.speculate_k = speculate_k
+        # k > 0 with no drafter given: the model-free n-gram drafter (zero
+        # extra params/forwards — the safe default).
+        self.drafter = (
+            drafter if drafter is not None or not speculate_k else NgramDrafter()
+        )
+        # speculate_k rows of buffer slack: a verify step writes W = k + 1
+        # positions even when the slot sits at its very last budgeted
+        # position — the slack keeps those writes in-bounds (a clamped
+        # dynamic_update_slice would silently shift the write over REAL
+        # prefix positions). Admission budgets still use max_total.
+        self.pool = SlotPool(cfg, num_slots, self.max_total + speculate_k)
         self.num_slots = num_slots
         self._free = list(range(num_slots))
         self._active: dict[int, _Active] = {}
@@ -258,6 +364,16 @@ class ContinuousScheduler:
                 "serve_request_seconds", "submit -> response complete")
             self._m_step_s = reg.histogram(
                 "serve_step_seconds", "one pool step (all slots, one token)")
+            if speculate_k:
+                self._m_spec_drafted = reg.counter(
+                    "serve_spec_drafted_total",
+                    "draft tokens proposed to verify steps")
+                self._m_spec_accepted = reg.counter(
+                    "serve_spec_accepted_total",
+                    "draft tokens the target model accepted")
+                self._m_spec_rejected = reg.counter(
+                    "serve_spec_rejected_total",
+                    "draft tokens rejected or wasted past a mismatch")
 
     # ---- request intake ---------------------------------------------------
 
@@ -384,11 +500,17 @@ class ContinuousScheduler:
         except Exception:
             self._free.append(slot)
             raise
+        spec = bool(self.speculate_k) and bool(req.get("speculate", True))
         st = _Active(
             order=order, ids=ids, prompt_len=L, pos=n, cur=PAD_ID,
             emitted=[], max_new=max_new,
             key=np.asarray(jax.random.PRNGKey(seed)),
             sample=sample, temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed, spec=spec,
+            dstate=(
+                self.drafter.start(ids) if spec and self.drafter is not None
+                else None
+            ),
             t_enqueue=t_enq or t_admit, t_admit=t_admit,
             # Dispatch-time edge: under async dispatch the prefill has been
             # ENQUEUED here, not finished; the full-prefill path syncs just
@@ -422,8 +544,10 @@ class ContinuousScheduler:
     # ---- stepping ---------------------------------------------------------
 
     def step(self) -> None:
-        """Advance every occupied slot one token (ONE pooled forward),
-        retire finished slots. No-op when the pool is idle."""
+        """Advance every occupied slot (ONE pooled forward): one token per
+        slot on the plain path, up to ``speculate_k + 1`` on the
+        speculative verify path. Retires finished slots; no-op when the
+        pool is idle."""
         if not self._active:
             if self._tel is not None:
                 self._m_active.set(0)
@@ -431,6 +555,12 @@ class ContinuousScheduler:
                 self._m_ready.set(len(self._done))
                 self._tel.maybe_flush()
             return
+        if self.speculate_k:
+            self._step_verify()
+        else:
+            self._step_plain()
+
+    def _step_plain(self) -> None:
         t_step = time.perf_counter()
         N = self.num_slots
         toks = np.full((N,), PAD_ID, np.int32)
@@ -461,6 +591,7 @@ class ContinuousScheduler:
                 picks[slot] = int(out[slot])
         for slot, st in list(self._active.items()):
             st.pos += 1
+            st.forwards += 1
             if st.pos < st.prompt_len:
                 st.cur = st.ids[st.pos]  # still consuming the prompt tail
                 continue
@@ -482,6 +613,146 @@ class ContinuousScheduler:
             self._m_backlog.set(len(self._queue))
             self._m_ready.set(len(self._done))
             self._tel.maybe_flush()
+
+    def _step_verify(self) -> None:
+        """One speculative verify step: every occupied slot feeds its
+        pending token plus up to ``speculate_k`` lookahead tokens — the
+        un-ingested prompt tail first (teacher-forced, like chunked
+        prefill), then drafter proposals — through ONE ``_pool_verify``
+        forward. The longest accepted prefix is kept; the rejected tail is
+        erased with an O(1) index rollback (``_pool_rollback``). Rows are
+        padded to the static width W = k + 1 and free slots ride along, so
+        mixed speculative/non-speculative pools never retrace. Emissions
+        go through the same ``_consume_pick`` as the plain path — greedy
+        answers are byte-identical to non-speculative serving
+        (tests/test_speculative.py pins this)."""
+        t_step = time.perf_counter()
+        N, W = self.num_slots, self.speculate_k + 1
+        toks = np.full((N, W), PAD_ID, np.int32)
+        keys = np.zeros((N, *np.shape(jax.random.PRNGKey(0))), np.uint32)
+        positions = np.zeros((N,), np.int32)
+        temps = np.ones((N,), np.float32)
+        rows: dict[int, tuple[list[int], int]] = {}
+        for slot, st in self._active.items():
+            row, n_drafted = build_verify_row(
+                st.ids + st.emitted, st.pos, self.speculate_k,
+                self.drafter if st.spec else None, st.dstate,
+            )
+            rows[slot] = (row, n_drafted)
+            toks[slot, : len(row)] = row
+            keys[slot] = st.key
+            positions[slot] = st.pos
+            temps[slot] = st.temperature
+        logits, self.pool.caches = _pool_verify(
+            self.params, self.pool.caches, jnp.asarray(toks), self.cfg
+        )
+        groups: dict[tuple, list[int]] = {}
+        for slot, st in self._active.items():
+            groups.setdefault((st.sample, st.top_k, st.top_p), []).append(slot)
+        picks: dict[int, np.ndarray] = {}
+        for (sample, top_k, top_p), slots in groups.items():
+            out = np.asarray(
+                _pick_pool_verify(
+                    logits, jnp.asarray(keys), jnp.asarray(positions),
+                    jnp.asarray(temps),
+                    sample=sample, top_k=top_k, top_p=top_p,
+                )
+            )
+            for slot in slots:
+                picks[slot] = out[slot]
+        delta = np.zeros((N,), np.int32)
+        drafted = accepted = 0
+        for slot, st in list(self._active.items()):
+            row, n_drafted = rows[slot]
+            slot_picks = picks[slot]
+            if st.sample and n_drafted:
+                # Rejection-sampling acceptance needs the target
+                # probabilities at the draft tokens — numbers that never
+                # leave the device on the plain path. Slice THIS slot's
+                # (W, V) rows on device; fetching the whole (N, W, V) pool
+                # tensor would tax every greedy neighbor's step latency.
+                slot_logits = np.asarray(logits[slot], np.float32)
+                pos0 = st.pos
+
+                def accept(j, draft, _l=slot_logits, _st=st, _p=pos0):
+                    probs = filtered_probs(
+                        _l[j], _st.temperature, _st.top_k, _st.top_p
+                    )
+                    return sampled_accept(
+                        probs, draft, keyed_rng(_st.seed, _p + j)
+                    )
+
+            else:
+
+                def accept(j, draft, _picks=slot_picks):
+                    pick = int(_picks[j])
+                    return pick == draft, pick
+
+            emitted, keep, n_accepted = judge_row(
+                row, st.pos, st.prompt_len, accept,
+                lambda j, _picks=slot_picks: int(_picks[j]),
+            )
+            st.forwards += 1
+            # Count as ACCEPTED only drafts whose emissions will actually
+            # be consumed — judge_row keeps judging past an EOS it cannot
+            # see, and counting those would skew acceptance telemetry on
+            # every finishing request. Counters must be final BEFORE the
+            # consume loop: retirement emits the request's span in there.
+            n_accepted = min(n_accepted, self._consumable(st, emitted))
+            drafted += n_drafted
+            accepted += n_accepted
+            st.drafted += n_drafted
+            st.accepted += n_accepted
+            delta[slot] = keep - W
+            st.pos += keep
+            if not emitted:
+                # Every fed position was still prompt: the next pending
+                # token is the known prompt token at the new position.
+                st.cur = st.ids[st.pos]
+                continue
+            if not st.emitted and st.t_prefill is not None:
+                # First generated pick for a tail-fed prompt: this verify
+                # ingested the final prompt token — close the prefill span
+                # here, exactly like the plain path's boundary transition.
+                st.t_prefill = time.perf_counter()
+            for tok in emitted:
+                self._consume_pick(slot, st, tok)
+                if slot not in self._active:
+                    break  # retired (EOS / budget): drop the row's tail
+        self.pool.caches = _pool_rollback(
+            self.pool.caches, jnp.asarray(delta)
+        )
+        self.stats["steps"] += 1
+        self.stats["drafted"] = self.stats.get("drafted", 0) + drafted
+        self.stats["accepted"] = self.stats.get("accepted", 0) + accepted
+        if self._tel is not None:
+            self._m_step_s.observe(time.perf_counter() - t_step)
+            self._m_steps.inc()
+            if drafted:
+                self._m_spec_drafted.inc(drafted)
+                if accepted:
+                    self._m_spec_accepted.inc(accepted)
+                if drafted - accepted:
+                    self._m_spec_rejected.inc(drafted - accepted)
+            self._m_active.set(len(self._active))
+            self._m_backlog.set(len(self._queue))
+            self._m_ready.set(len(self._done))
+            self._tel.maybe_flush()
+
+    def _consumable(self, st: _Active, emitted: list[int]) -> int:
+        """How many of a verify row's emissions ``_consume_pick`` will
+        consume before retiring the slot (the finishing token included) —
+        a side-effect-free twin of its EOS/budget rules, used to finalize
+        acceptance counters before retirement emits the request span."""
+        n, cnt = 0, len(st.emitted)
+        for tok in emitted:
+            n += 1
+            if tok == self.tok.eos_id or cnt >= st.max_new:
+                break
+            cnt += 1
+            if cnt >= st.max_new:
+                break
+        return n
 
     def _consume_pick(self, slot: int, st: _Active, tokv: int) -> None:
         """Apply one generated token: retire on EOS or budget exhaustion,
@@ -521,6 +792,13 @@ class ContinuousScheduler:
                 "queue_s": round(queue_s, 6),
                 "total_s": round(total_s, 6),
             }
+            if st.forwards:
+                # Decode forwards this request rode (verify or plain steps;
+                # prefill excluded) — summarize derives tokens-per-forward.
+                span["forwards"] = st.forwards
+            if st.spec:
+                span["drafted"] = st.drafted
+                span["draft_accepted"] = st.accepted
             self._m_queue_s.observe(queue_s)
             self._m_total_s.observe(total_s)
             if st.t_prefill is not None:

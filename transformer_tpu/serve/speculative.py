@@ -1,0 +1,557 @@
+"""Speculative decoding for the LM serving path: draft, verify, rollback.
+
+Incremental decoding is memory-bandwidth-bound — every step moves all
+params plus the whole KV cache to emit ONE token ("Fast Transformer
+Decoding", Shazeer, arXiv:1911.02150) — so past chunked prefill the next
+serving win is more tokens per model forward. Speculative decoding gets
+there without touching the model: a cheap DRAFTER proposes ``k`` candidate
+continuation tokens, one matmul-rich verify forward
+(``models.transformer.transformer_verify`` — the same S_q > 1 cache-write
+path chunked prefill rides) scores all ``k + 1`` positions at once, and the
+longest draft prefix the model agrees with is accepted. Rollback of the
+rejected tail is O(1): reset ``cache["index"]``
+(``ops.attention.rollback_cache``) — stale K/V beyond the index are already
+invisible to the offset causal mask, and the next real write overwrites
+them in place.
+
+Two drafters ship behind one duck-typed interface
+(``start(prompt_ids) -> state``; ``propose(state, context, k) -> tokens``):
+
+- :class:`NgramDrafter` — model-free prompt-lookup (Saxena-style): propose
+  the continuation of the most recent earlier occurrence of the context's
+  suffix n-gram. Zero extra params or forwards; strong on translation,
+  summarization-with-quotes, and code, where output copies input spans.
+- :class:`ModelDrafter` — a small draft model sharing the target
+  tokenizer: greedy proposals from its own KV cache, synced to the
+  accepted history by the same rollback-by-index trick.
+
+Acceptance is LOSSLESS. Greedy requests accept draft ``d_{j+1}`` iff it
+equals ``argmax`` of the verify logits at position ``j`` — the emitted
+stream is byte-identical to plain greedy decode (pinned by
+``tests/test_speculative.py``). Sampled requests use standard
+rejection-sampling acceptance (Leviathan et al., arXiv:2211.17192): accept
+``d`` with probability ``p(d)`` (the drafters are deterministic, so the
+draft distribution is a point mass), else emit a draw from the residual
+``p`` with ``d`` removed — the OUTPUT DISTRIBUTION equals plain sampling,
+though individual draws differ (different rng consumption).
+
+Rolling-window caches (``attention_window``) are structurally incompatible
+with rollback-by-index — a speculative write evicts a slot that may still
+be in-window after rollback — so speculation is refused for those configs
+(``rollback_cache`` raises; the scheduler gates at construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Protocol, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.data.seeding import keyed_rng
+from transformer_tpu.models.decoder import init_decoder_caches
+from transformer_tpu.models.transformer import (
+    transformer_prefill,
+    transformer_verify,
+)
+from transformer_tpu.ops.attention import rollback_cache
+from transformer_tpu.train.decode import _bucket, prefill_len_for, sample_token
+
+
+class Drafter(Protocol):
+    """What the scheduler and the standalone loop require of a drafter."""
+
+    def start(self, prompt_ids: Sequence[int]) -> Any:
+        """Per-request draft state (None for stateless drafters)."""
+
+    def propose(self, state: Any, context: Sequence[int], k: int) -> list[int]:
+        """Up to ``k`` candidate tokens continuing ``context`` (the full
+        determined token history: prompt + accepted generations). May
+        return fewer than ``k`` (or none) when it has nothing credible —
+        the verify row simply carries fewer candidates that round."""
+
+
+# --------------------------------------------------------------------------
+# drafters
+
+
+@dataclasses.dataclass
+class _NgramState:
+    """Incremental per-request lookup index: n-gram tuple -> start
+    positions (ascending). Contexts only ever GROW (the verified history is
+    append-only), so each ``propose`` indexes just the new tail — O(max_n)
+    per new token instead of rescanning the whole context every step."""
+
+    ctx: list[int] = dataclasses.field(default_factory=list)
+    occ: dict[tuple[int, ...], list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class NgramDrafter:
+    """Model-free prompt-lookup drafting: find the most recent earlier
+    occurrence of the context's trailing n-gram and propose the tokens that
+    followed it. Tries the longest suffix first (``max_n`` down to
+    ``min_n``) so a long exact match wins over a short ambiguous one."""
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"need 1 <= min_n <= max_n, got {min_n}/{max_n}")
+        self.max_n = max_n
+        self.min_n = min_n
+
+    def start(self, prompt_ids: Sequence[int]) -> _NgramState:
+        return _NgramState()
+
+    def _index(self, state: _NgramState, context: Sequence[int]) -> list[int]:
+        ctx, occ = state.ctx, state.occ
+        new = [int(t) for t in context[len(ctx):]]
+        # Contexts are append-only by construction (the verified history
+        # never rewinds); spot-check the boundary token instead of
+        # re-comparing the whole prefix — a full compare would make every
+        # propose O(context) and defeat the incremental index.
+        assert not ctx or len(context) < len(ctx) or (
+            int(context[len(ctx) - 1]) == ctx[-1]
+        ), "NgramDrafter contexts must grow append-only"
+        for tok in new:
+            ctx.append(tok)
+            for n in range(self.min_n, self.max_n + 1):
+                if len(ctx) >= n:
+                    occ.setdefault(tuple(ctx[-n:]), []).append(len(ctx) - n)
+        return ctx
+
+    def propose(
+        self, state: _NgramState | None, context: Sequence[int], k: int
+    ) -> list[int]:
+        if state is None:  # stateless callers pay the one-shot index cost
+            state = _NgramState()
+        ctx = self._index(state, context)
+        for n in range(min(self.max_n, len(ctx) - 1), self.min_n - 1, -1):
+            # Most recent earlier occurrence WITH a full k-token
+            # continuation wins; a match hugging the context's end (the
+            # common case in cyclic text — the previous period of the
+            # cycle) has almost no tokens after it, so it is only the
+            # fallback. Overlap with the suffix itself is fine.
+            starts = state.occ.get(tuple(ctx[-n:]), [])
+            fallback: list[int] | None = None
+            for start in reversed(starts):
+                if start == len(ctx) - n:
+                    continue  # the suffix itself
+                cont = ctx[start + n : start + n + k]
+                if len(cont) == k:
+                    return cont
+                if cont and fallback is None:
+                    fallback = cont
+            if fallback:
+                return fallback
+        return []
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _draft_ingest(params, caches, toks, cfg: ModelConfig):
+    """Feed (1, w) tokens into the draft model's cache at its own index;
+    returns ((1, V) last-position logits, caches). Widths are powers of two
+    (the sync loop below splits deltas that way), so the compile set stays
+    O(log max_len)."""
+    return transformer_prefill(
+        params, toks, None, None, caches, caches[0]["index"], cfg
+    )
+
+
+@dataclasses.dataclass
+class _DraftState:
+    caches: list[dict[str, Any]]
+    fed: list[int]
+
+
+class ModelDrafter:
+    """A small decoder-only draft model sharing the target tokenizer.
+
+    Keeps one batch-1 KV cache per request, greedy-extends from it, and
+    re-syncs to the verified history by the same O(1) rollback-by-index
+    mechanism the target model uses: roll back to the longest common prefix
+    of what it fed and what was actually accepted, then re-ingest the delta
+    in power-of-two chunks."""
+
+    def __init__(
+        self,
+        params,
+        cfg: ModelConfig,
+        max_total: int,
+        eos_id: int | None = None,
+        target_vocab_size: int | None = None,
+    ):
+        if not cfg.decoder_only:
+            raise ValueError("ModelDrafter needs a decoder-only draft model")
+        if cfg.attention_window:
+            raise ValueError(
+                "ModelDrafter cannot use a rolling-window cache: rollback "
+                "by index cannot restore evicted slots"
+            )
+        if (
+            target_vocab_size is not None
+            and cfg.target_vocab_size != target_vocab_size
+        ):
+            # Fail at construction, not mid-serve: a draft token id outside
+            # the target vocab would index past the target's (V,) logits in
+            # the acceptance path and kill every in-flight request.
+            raise ValueError(
+                f"draft model vocab ({cfg.target_vocab_size}) != target "
+                f"vocab ({target_vocab_size}) — speculative drafting "
+                "requires a SHARED tokenizer"
+            )
+        self.params, self.cfg = params, cfg
+        self.max_total = max_total
+        self.eos_id = eos_id
+
+    def start(self, prompt_ids: Sequence[int]) -> _DraftState:
+        return _DraftState(
+            caches=init_decoder_caches(self.cfg, 1, self.max_total), fed=[]
+        )
+
+    def propose(
+        self, state: _DraftState, context: Sequence[int], k: int
+    ) -> list[int]:
+        ctx = [int(t) for t in context]
+        # The draft model's own position/buffer budget caps how far ahead
+        # it can look; a capped (or empty) proposal list is always valid.
+        k = min(k, self.max_total - 1 - len(ctx),
+                self.cfg.max_position - len(ctx))
+        if k <= 0 or not ctx:
+            return []
+        # Re-sync: keep the longest common prefix of (fed, ctx), capped one
+        # short of ctx so the final context token is always re-fed — its
+        # forward produces the logits the first proposal comes from.
+        m = 0
+        limit = min(len(state.fed), len(ctx) - 1)
+        while m < limit and state.fed[m] == ctx[m]:
+            m += 1
+        if m < len(state.fed):
+            state.caches = [rollback_cache(c, m) for c in state.caches]
+            state.fed = state.fed[:m]
+        delta = ctx[m:]
+        logits = None
+        while delta:
+            w = prefill_len_for(len(delta)) or 1
+            logits, state.caches = _draft_ingest(
+                self.params, state.caches,
+                jnp.asarray([delta[:w]], jnp.int32), self.cfg,
+            )
+            state.fed.extend(delta[:w])
+            delta = delta[w:]
+        out: list[int] = []
+        for i in range(k):
+            d = int(np.argmax(np.asarray(logits[0])))
+            out.append(d)
+            if self.eos_id is not None and d == self.eos_id:
+                break  # nothing credible follows EOS
+            if i + 1 < k:
+                logits, state.caches = _draft_ingest(
+                    self.params, state.caches,
+                    jnp.asarray([[d]], jnp.int32), self.cfg,
+                )
+                state.fed.append(d)
+        return out
+
+
+def drafter_from_flags(
+    draft_checkpoint: str,
+    draft_ngram: int,
+    max_total: int,
+    eos_id: int | None = None,
+    target_vocab_size: int | None = None,
+):
+    """Build the configured drafter: a draft-model export when
+    ``draft_checkpoint`` names one (loaded via the same ``load_export``
+    path the serving CLIs use — it must share the target tokenizer, which
+    ``target_vocab_size`` enforces at startup), else the model-free n-gram
+    drafter with ``draft_ngram`` as its longest lookup n-gram."""
+    if draft_checkpoint:
+        from transformer_tpu.cli.translate import load_export
+
+        d_params, d_cfg = load_export(draft_checkpoint)
+        return ModelDrafter(
+            d_params, d_cfg, max_total, eos_id=eos_id,
+            target_vocab_size=target_vocab_size,
+        )
+    return NgramDrafter(max_n=max(1, draft_ngram))
+
+
+# --------------------------------------------------------------------------
+# verify-row planning and judging (shared by the scheduler and the
+# standalone loop — ONE acceptance rule, so the two paths cannot drift)
+
+
+def build_verify_row(
+    history: Sequence[int],
+    pos: int,
+    k: int,
+    drafter: Drafter | None,
+    dstate: Any,
+) -> tuple[list[int], int]:
+    """Plan one verify forward for a stream whose cache holds positions
+    ``< pos``: ``row[0]`` is the pending token ``history[pos]``, followed by
+    up to ``k`` lookahead tokens — already-determined history first (the
+    un-ingested prompt tail, teacher-forced exactly like chunked prefill),
+    then drafter proposals continuing the END of the history. Returns
+    ``(row, n_drafted)``; ``len(row) <= k + 1``."""
+    history = list(history)
+    row = [int(history[pos])]
+    forced = [int(t) for t in history[pos + 1 : pos + 1 + k]]
+    row.extend(forced)
+    n_drafted = 0
+    want = k - len(forced)
+    if want > 0 and drafter is not None:
+        props = [int(t) for t in drafter.propose(dstate, history, want)]
+        props = props[:want]
+        row.extend(props)
+        n_drafted = len(props)
+    return row, n_drafted
+
+
+def judge_row(
+    row: Sequence[int],
+    pos: int,
+    prompt_len: int,
+    accept: Callable[[int, int], tuple[bool, int]],
+    bonus: Callable[[int], int],
+) -> tuple[list[int], int, int]:
+    """Walk one verify row's picks, applying the acceptance rule.
+
+    ``accept(j, draft) -> (accepted, token)`` judges the draft fed at row
+    index ``j + 1`` against position ``j``'s verify output (greedy: token
+    is the argmax pick, accepted iff it equals the draft; sampling: the
+    rejection-sampling draw). ``bonus(j)`` picks the free extra token when
+    every draft before the row's end survived. Positions still inside the
+    prompt are teacher-forced — their picks are discarded, exactly like
+    the in-prompt ticks of ``lm_generate``.
+
+    Returns ``(emitted, keep, n_accepted)``: the generated tokens in order,
+    how many fed tokens remain VALID in the cache (the caller rolls the
+    index back to ``pos + keep``), and how many drafts were accepted. The
+    last emitted token (mismatch draw or bonus) has NOT been ingested — it
+    is the stream's next pending token."""
+    emitted: list[int] = []
+    n_accepted = 0
+    for j in range(len(row)):
+        if pos + j + 1 < prompt_len:
+            continue  # next position is still prompt: pick discarded
+        if j + 1 < len(row):
+            ok, tok = accept(j, int(row[j + 1]))
+            emitted.append(int(tok))
+            if not ok:
+                return emitted, j + 1, n_accepted
+            n_accepted += 1
+        else:
+            emitted.append(int(bonus(j)))
+            return emitted, j + 1, n_accepted
+    return emitted, len(row), n_accepted
+
+
+def filtered_probs(
+    logits: np.ndarray, temperature: float, top_k: int, top_p: float
+) -> np.ndarray:
+    """The ``sample_token`` distribution (f32 softmax over temperature-
+    scaled logits, optional top-k then top-p truncation) replicated in
+    numpy — rejection-sampling acceptance needs the probability the target
+    model assigns to a draft token, which never leaves the device on the
+    plain sampling path."""
+    logits = np.asarray(logits, np.float32) / max(float(temperature), 1e-6)
+    if top_k > 0:
+        kth = np.sort(logits)[-min(top_k, logits.size)]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p < 1.0:
+        order = np.sort(logits)[::-1]
+        shifted = order - order[0]
+        probs = np.exp(shifted) / np.sum(np.exp(shifted))
+        exclusive = np.cumsum(probs) - probs
+        kept = exclusive < top_p
+        thresh = np.min(np.where(kept, order, np.inf))
+        logits = np.where(logits < thresh, -np.inf, logits)
+    logits = logits - np.max(logits)
+    p = np.exp(logits)
+    return p / np.sum(p)
+
+
+def sampled_accept(
+    probs: np.ndarray, draft: int, rng: np.random.Generator
+) -> tuple[bool, int]:
+    """Standard rejection-sampling acceptance against a deterministic
+    drafter (draft distribution = point mass): accept ``draft`` with
+    probability ``p(draft)``; on rejection draw from the residual ``p``
+    with the draft's mass removed. Output distribution == plain sampling."""
+    p_d = float(probs[draft])
+    if rng.random() < p_d:
+        return True, draft
+    resid = probs.copy()
+    resid[draft] = 0.0
+    total = float(resid.sum())
+    if total <= 0.0:
+        # The draft carried ALL the mass — acceptance probability was 1,
+        # so this branch is unreachable except for fp dust; emit the draft.
+        return True, draft
+    return False, int(rng.choice(len(resid), p=resid / total))
+
+
+# --------------------------------------------------------------------------
+# standalone speculative generation (batch-1 host loop)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _verify(params, caches, toks, cfg: ModelConfig):
+    """One verify forward for a (1, w) row at the cache's own index."""
+    pos = caches[0]["index"]
+    return transformer_verify(params, toks, caches, pos, cfg)
+
+
+def verify_row_picks(
+    logits, base_key, position, temperature, *, sample, top_k, top_p
+):
+    """(w, V) verify logits -> (w,) picks, one per fed position, with the
+    same position-keyed rng folding ``lm_generate`` uses (``fold_in(rng,
+    position + j)``) so sampled draws are deterministic per position. THE
+    one definition of the verify-pick math — the standalone loop jits it
+    directly (``_pick_row``) and the scheduler vmaps it over the slot pool
+    (``_pick_pool_verify``), so the two paths cannot drift."""
+
+    def one(row_logits, j):
+        key = jax.random.fold_in(base_key, position + j)
+        return sample_token(
+            row_logits[None], key, sample=sample, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        )[0]
+
+    return jax.vmap(one)(logits, jnp.arange(logits.shape[0]))
+
+
+_pick_row = partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))(
+    verify_row_picks
+)
+
+
+def speculative_generate(
+    params,
+    cfg: ModelConfig,
+    prompt_ids: Sequence[int],
+    max_new: int,
+    eos_id: int,
+    *,
+    speculate_k: int,
+    drafter: Drafter | None = None,
+    sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    seed: int = 0,
+    prefill_chunk: int = 0,
+) -> tuple[list[int], dict]:
+    """Batch-1 speculative continuation of a BOS-led prompt.
+
+    Returns ``(tokens, stats)`` where ``tokens`` is the generated stream
+    (EOS included when generated, like an ``lm_generate`` row before its
+    PAD tail) and ``stats`` counts ``verify_forwards`` / ``drafted`` /
+    ``accepted`` — tokens-per-forward is ``len(tokens) /
+    verify_forwards``. Greedy output is byte-identical to
+    ``lm_generate``'s (test-pinned); sampled output is
+    distribution-lossless via rejection sampling.
+    """
+    if cfg.attention_window:
+        raise ValueError(
+            "speculative decoding cannot roll back a rolling-window cache "
+            "(attention_window configs serve non-speculatively)"
+        )
+    if speculate_k < 1:
+        raise ValueError(f"speculate_k must be >= 1, got {speculate_k}")
+    ids = [int(t) for t in prompt_ids]
+    L = len(ids)
+    if L < 1:
+        raise ValueError("prompt must carry at least the BOS token")
+    max_new = min(max_new, cfg.max_position - L)
+    if drafter is None:
+        drafter = NgramDrafter()
+    # Power-of-two cache buffer (speculate_k slack keeps boundary-straddling
+    # verify writes in-bounds): buffer size is a compiled shape, so bucketing
+    # keeps the verify/pick compile set O(log max_len) across prompt lengths
+    # — same reason generate() buckets its prompt widths. Oversized rows are
+    # invisible (the prefix mask hides everything >= index).
+    buf = _bucket(
+        L + max_new + 1 + speculate_k,
+        cfg.max_position + 1 + speculate_k, floor=8,
+    )
+    caches = init_decoder_caches(cfg, 1, buf)
+    base_key = jax.random.PRNGKey(seed)
+    stats = {"verify_forwards": 0, "drafted": 0, "accepted": 0}
+    if max_new < 1:
+        return [], stats
+
+    # Bucketed prefill of the prompt prefix (one short of the full prompt,
+    # so the boundary pick is always made by a verify forward).
+    history = list(ids)
+    pos = 0
+    n = min(prefill_len_for(L, prefill_chunk), L - 1)
+    if n >= 1:
+        _, caches = transformer_prefill(
+            params, jnp.asarray([ids[:n]], jnp.int32), None, None, caches,
+            0, cfg, chunk=prefill_chunk,
+        )
+        pos = n
+    dstate = drafter.start(ids)
+    out: list[int] = []
+    finished = False
+    while not finished:
+        # Cap the row so its writes stay inside the cache buffer.
+        k_row = min(speculate_k, buf - pos - 1)
+        row, n_drafted = build_verify_row(history, pos, k_row, drafter, dstate)
+        stats["drafted"] += n_drafted
+        toks = jnp.asarray([row], jnp.int32)
+        logits, caches = _verify(params, caches, toks, cfg)
+        stats["verify_forwards"] += 1
+        picks = np.asarray(
+            _pick_row(
+                logits[0], base_key, jnp.int32(pos),
+                jnp.float32(temperature),
+                sample=sample, top_k=top_k, top_p=top_p,
+            )
+        )
+        if sample:
+            logits_np = np.asarray(logits[0], np.float32)
+
+            def accept(j, draft):
+                probs = filtered_probs(
+                    logits_np[j], temperature, top_k, top_p
+                )
+                return sampled_accept(probs, draft, keyed_rng(seed, pos + j))
+
+        else:
+
+            def accept(j, draft):
+                pick = int(picks[j])
+                return pick == draft, pick
+
+        emitted, keep, n_accepted = judge_row(
+            row, pos, L, accept, lambda j: int(picks[j])
+        )
+        n_consumed = 0
+        for tok in emitted:
+            if len(out) >= max_new:
+                finished = True
+                break
+            n_consumed += 1
+            out.append(int(tok))
+            if tok == eos_id:
+                finished = True
+                break
+        # Only consumed emissions count toward acceptance telemetry (the
+        # row's post-EOS/post-budget tail was judged but never emitted).
+        stats["accepted"] += min(n_accepted, n_consumed)
+        if finished:
+            break
+        pos += keep
+        history = ids + out
+        # O(1) rollback: hide the rejected tail from every later read.
+        caches = [rollback_cache(c, pos) for c in caches]
+    return out, stats

@@ -396,6 +396,42 @@ def beam_search_decode(
     )[:, 0, :]
 
 
+def lm_generate_speculative(
+    params,
+    prompt_ids,
+    cfg: ModelConfig,
+    max_new: int,
+    eos_id: int,
+    *,
+    speculate_k: int,
+    drafter=None,
+    sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    seed: int = 0,
+    prefill_chunk: int = 0,
+) -> tuple[list[int], dict]:
+    """Standalone speculative counterpart of ``lm_generate`` (batch-1):
+    a drafter proposes ``speculate_k`` lookahead tokens, one multi-token
+    verify forward scores them all, the accepted prefix is kept and the
+    rejected tail is erased by O(1) cache-index rollback. Greedy output is
+    byte-identical to ``lm_generate``'s (test-pinned); sampling is
+    distribution-lossless via rejection acceptance. Returns ``(tokens,
+    stats)`` — ``stats["verify_forwards"]`` divides into ``len(tokens)``
+    for tokens-per-forward. ``drafter=None`` uses the model-free n-gram
+    drafter; see ``transformer_tpu.serve.speculative`` for the drafter
+    interface and the draft-model variant."""
+    from transformer_tpu.serve.speculative import speculative_generate
+
+    return speculative_generate(
+        params, cfg, prompt_ids, max_new, eos_id,
+        speculate_k=speculate_k, drafter=drafter, sample=sample,
+        temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+        prefill_chunk=prefill_chunk,
+    )
+
+
 def _pad_batch(encoded: list[list[int]], width: int):
     """Stack variable-length id lists into a PAD-canvas of power-of-two rows
     (shared by ``translate`` and ``generate``); returns (ids, n_real_rows)."""
@@ -454,6 +490,8 @@ def generate(
     top_p: float = 1.0,
     seed: int = 0,
     prefill_chunk: int = 0,
+    speculate_k: int = 0,
+    drafter=None,
 ) -> list[str]:
     """Text-in/text-out continuation for ``cfg.decoder_only`` models: each
     prompt is BOS-led (matching the LM training windows, ``data.pipeline.
@@ -466,7 +504,12 @@ def generate(
     bucketed by ``prefill_len_for`` — is ingested in one pass through
     ``transformer_prefill`` (``prefill_chunk`` bounds per-call activation
     memory; 0 = one chunk); outputs are bit-identical to the pure
-    token-by-token loop."""
+    token-by-token loop.
+
+    ``speculate_k > 0`` routes each prompt through speculative decoding
+    (``lm_generate_speculative``, batch-1 per prompt): greedy text is
+    byte-identical, at fewer model forwards per token when the drafter
+    (default: the model-free n-gram prompt-lookup drafter) lands."""
     if not cfg.decoder_only:
         raise ValueError("generate() is for decoder_only models; use translate()")
     if isinstance(prompts, str):
@@ -481,6 +524,22 @@ def generate(
     # The position budget caps generation: clamp rather than raise so the
     # default max_new works for any model (standard generation semantics).
     max_new = min(max_new, cfg.max_position - longest)
+    if speculate_k > 0:
+        texts = []
+        for e in encoded:
+            toks, _ = lm_generate_speculative(
+                params, e, cfg, max_new, tokenizer.eos_id,
+                speculate_k=speculate_k, drafter=drafter,
+                sample=temperature > 0.0, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                prefill_chunk=prefill_chunk,
+            )
+            texts.extend(
+                _detokenize_rows(
+                    [toks] if toks else [[PAD_ID]], 1, tokenizer
+                )
+            )
+        return texts
     width = _bucket(longest, cfg.max_position, floor=8)
     ids, n = _pad_batch(encoded, width)
     # Prefill only the prefix every REAL row agrees is prompt (lm_generate's
