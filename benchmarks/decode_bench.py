@@ -19,7 +19,10 @@ Prints ONE JSON line:
 chunked prefill ingests a prompt token than the token-by-token decode loop
 does. ``prefill_forward_calls`` pins the structural claim — a 64-token
 prompt compiles to ceil(prompt_len / chunk) decoder forwards, not 64
-sequential steps.
+sequential steps. ``--prefix_reuse`` adds the cross-request dimension: a
+repeated-system-prompt workload through the continuous scheduler with the
+prefix KV cache on vs off, reporting the prompt-token hit rate and the
+prefill forwards the trie restore saved (greedy answers asserted identical).
 """
 
 from __future__ import annotations
@@ -45,10 +48,21 @@ def main() -> None:
                         "'2,4'): per k, decode batch-1 speculatively with "
                         "the n-gram drafter and report tokens/s, "
                         "tokens-per-forward, and draft acceptance rate")
+    p.add_argument("--prefix_reuse", action="store_true",
+                   help="run a repeated-system-prompt workload through the "
+                        "continuous scheduler with the cross-request prefix "
+                        "cache on vs off, reporting prompt-token hit rate "
+                        "and prefill forwards saved")
+    p.add_argument("--prefix_requests", type=int, default=16,
+                   help="requests in the --prefix_reuse workload (each = "
+                        "shared system prompt + small unique tail)")
+    p.add_argument("--prefix_block", type=int, default=16,
+                   help="prefix-cache block granularity for --prefix_reuse")
     p.add_argument("--rows_out", type=str, default="",
                    help="append bench_rows.jsonl-compatible rows for the "
-                        "--speculate_k sweep to this file ('' = print them "
-                        "to stderr; stdout stays one summary JSON line)")
+                        "--speculate_k / --prefix_reuse sweeps to this file "
+                        "('' = print them to stderr; stdout stays one "
+                        "summary JSON line)")
     p.add_argument("--reps", type=int, default=5,
                    help="timed repetitions (best-of is reported)")
     p.add_argument("--layers", type=int, default=2)
@@ -186,6 +200,82 @@ def main() -> None:
                 "new_tokens": len(toks),
             })
 
+    # ---- cross-request prefix reuse (continuous scheduler) ----------------
+    # Headline: the fraction of prompt tokens served from stored KV blocks
+    # instead of a prefill forward, on the workload the prefix cache exists
+    # for — every request carrying the same system prompt plus a small
+    # unique tail (docs/SERVING.md "Cross-request prefix KV cache").
+    prefix = None
+    if args.prefix_reuse:
+        from transformer_tpu.serve import ContinuousScheduler, PrefixCache
+
+        class _IdTok:
+            """Tokens ARE ids ("3 17 5" -> [3, 17, 5]): the scheduler needs
+            only encode/decode/bos/eos, and a real subword vocab would just
+            blur the token accounting this sweep reports."""
+
+            bos_id, eos_id = 1, 2
+
+            def encode(self, text):
+                return [int(t) for t in text.split()]
+
+            def decode(self, toks):
+                return " ".join(str(t) for t in toks)
+
+        tok = _IdTok()
+        system = rng.integers(3, args.vocab - 2, args.prompt_len)
+        reqs = [
+            {
+                "prompt": " ".join(
+                    map(str, [*system, *rng.integers(3, args.vocab - 2, 4)])
+                ),
+                "max_new": 4,
+            }
+            for _ in range(args.prefix_requests)
+        ]
+
+        results = {}
+        for label, cache in (
+            ("off", None),
+            ("on", PrefixCache(
+                cfg, block_tokens=args.prefix_block, budget_mb=64)),
+        ):
+            sched = ContinuousScheduler(
+                params, cfg, tok, num_slots=2,
+                prefill_chunk=args.chunk, prefix_cache=cache,
+            )
+            t0 = time.perf_counter()
+            out = sched.run([dict(r) for r in reqs])
+            wall = time.perf_counter() - t0
+            assert all("continuation" in r for r in out), out
+            results[label] = {
+                "answers": [r["continuation"] for r in out],
+                "wall_s": wall,
+                **{k: sched.stats[k] for k in (
+                    "prompt_tokens", "prefix_hit_tokens", "prefill_forwards",
+                )},
+            }
+        assert results["on"]["answers"] == results["off"]["answers"], (
+            "prefix cache changed greedy answers"
+        )
+        on, off = results["on"], results["off"]
+        prefix = {
+            "requests": args.prefix_requests,
+            "system_prompt_tokens": args.prompt_len,
+            "block_tokens": args.prefix_block,
+            "prompt_tokens": on["prompt_tokens"],
+            "prefix_hit_tokens": on["prefix_hit_tokens"],
+            "hit_rate": round(
+                on["prefix_hit_tokens"] / on["prompt_tokens"], 4
+            ),
+            "prefill_forwards": on["prefill_forwards"],
+            "prefill_forwards_saved": (
+                off["prefill_forwards"] - on["prefill_forwards"]
+            ),
+            "wall_s_on": round(on["wall_s"], 3),
+            "wall_s_off": round(off["wall_s"], 3),
+        }
+
     print(json.dumps({
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "decode_tokens_per_sec": round(decode_tok_s, 1),
@@ -198,7 +288,32 @@ def main() -> None:
         "chunk": args.chunk,
         "device": f"{dev.platform}:{dev.device_kind}",
         **({"speculative": speculative} if speculative else {}),
+        **({"prefix_reuse": prefix} if prefix else {}),
     }))
+
+    if prefix:
+        row = json.dumps({
+            "metric": "prefix cache prompt-token hit rate",
+            "value": prefix["hit_rate"],
+            "unit": "fraction",
+            "config": {
+                "layers": args.layers, "d_model": args.d_model,
+                "heads": args.heads, "dff": args.dff,
+                "prompt_len": args.prompt_len,
+                "requests": args.prefix_requests,
+                "block_tokens": args.prefix_block,
+                "chunk": args.chunk,
+            },
+            "prefill_forwards_saved": prefix["prefill_forwards_saved"],
+            "prefix_hit_tokens": prefix["prefix_hit_tokens"],
+            "device": f"{dev.platform}:{dev.device_kind}",
+            "vs_baseline": None,
+        })
+        if args.rows_out:
+            with open(args.rows_out, "a", encoding="utf-8") as f:
+                f.write(row + "\n")
+        else:
+            print(row, file=sys.stderr)
 
     if speculative:
         # bench_rows.jsonl-compatible rows: one per sweep point, so rounds

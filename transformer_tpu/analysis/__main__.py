@@ -73,6 +73,7 @@ def _cmd_contracts(args: argparse.Namespace) -> int:
 def _cmd_retrace(args: argparse.Namespace) -> int:
     from transformer_tpu.analysis.retrace import (
         decode_retrace_report,
+        prefix_cache_retrace_report,
         speculative_retrace_report,
         train_retrace_report,
     )
@@ -80,6 +81,7 @@ def _cmd_retrace(args: argparse.Namespace) -> int:
     deltas = (
         decode_retrace_report(steps=args.steps)
         + speculative_retrace_report(steps=args.steps)
+        + prefix_cache_retrace_report(steps=args.steps)
         + train_retrace_report(steps=args.steps)
     )
     ok = all(d.within_budget for d in deltas)

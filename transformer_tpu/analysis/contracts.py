@@ -20,6 +20,12 @@ Contracts:
   indistinguishable from k+1 repeated incremental steps, and return
   per-position logits — the speculative scheduler interleaves the two
   paths (plus index rollback) over one slot pool.
+- **prefix_restore_parity** — a slot cache rebuilt from prefix-cache KV
+  blocks (``ops.attention.slice_kv_blocks`` → ``insert_kv_blocks``) must
+  equal a chunk-prefilled cache in structure, shape, and dtype across
+  plain/int8/GQA layouts: cache-hit admissions prefill the unmatched
+  suffix INTO the restored cache, so restore/prefill drift poisons every
+  hit.
 - **softmax_f32** — ``dot_product_attention`` promises its softmax runs in
   fp32 even under bf16 compute (``ops/attention.py``); checked by walking
   the jaxpr of the forward for ``exp`` equations and asserting their
@@ -229,6 +235,58 @@ def check_verify_cache_parity(cfg: ModelConfig, batch: int = 2, k: int = 3) -> s
     return (
         f"{len(a)} cache leaves identical across verify/{k + 1} steps; "
         f"logits {want} {v_logits.dtype}"
+    )
+
+
+def check_prefix_restore_parity(
+    cfg: ModelConfig, batch: int = 1, blocks: int = 2, block: int = 4
+) -> str:
+    """A slot cache rebuilt from prefix-cache blocks (``slice_kv_blocks`` →
+    ``insert_kv_blocks`` round trip, index advanced to the restored width)
+    must be structurally indistinguishable — pytree structure, shapes, AND
+    dtypes — from one chunk-prefilled over the same tokens: the scheduler
+    prefills the unmatched SUFFIX into the restored cache and then decodes
+    incrementally, so any layout drift between restore and prefill poisons
+    every cache-hit request. Traced abstractly (eval_shape) across
+    plain/int8/GQA layouts; rolling-window configs are excluded (the prefix
+    cache refuses them at construction)."""
+    from transformer_tpu.models.decoder import init_decoder_caches
+    from transformer_tpu.models.transformer import transformer_prefill
+    from transformer_tpu.ops.attention import insert_kv_blocks, slice_kv_blocks
+
+    total = 16
+    n = blocks * block
+    params = abstract_params(cfg)
+
+    def prefill_path(params, tokens):
+        caches = init_decoder_caches(cfg, batch, total)
+        _, caches = transformer_prefill(
+            params, tokens, None, None, caches, 0, cfg, chunk=block
+        )
+        return caches
+
+    def restore_path(params, tokens):
+        donor = prefill_path(params, tokens)
+        fresh = init_decoder_caches(cfg, batch, total)
+        out = []
+        for d, c in zip(donor, fresh):
+            for j in range(blocks):
+                c = insert_kv_blocks(
+                    c, slice_kv_blocks(d, j * block, block), j * block
+                )
+            out.append(dict(c, index=jnp.asarray(n, jnp.int32)))
+        return out
+
+    tokens = _ids(batch, n)
+    a = _tree_spec(jax.eval_shape(prefill_path, params, tokens))
+    b = _tree_spec(jax.eval_shape(restore_path, params, tokens))
+    assert a == b, (
+        "trie-restored and chunk-prefilled caches disagree on "
+        f"layout/dtype:\n  prefill: {a}\n  restore: {b}"
+    )
+    return (
+        f"{len(a)} cache leaves identical across restore/prefill "
+        f"({blocks}x{block}-token blocks)"
     )
 
 
@@ -495,6 +553,14 @@ _CONTRACTS: list[tuple[str, Callable[[ModelConfig], str], Callable[[ModelConfig]
     # covers every cache variant (plain/int8/rolling/GQA) — rolling caches
     # can't ROLL BACK, but their verify writes must still match steps.
     ("verify_cache_parity", check_verify_cache_parity, lambda c: c.decoder_only),
+    # The prefix cache refuses rolling-window caches (absolute-position
+    # rows are evicted on wrap), so the restore/prefill structural parity
+    # applies to every OTHER LM cache variant: plain, int8, GQA.
+    (
+        "prefix_restore_parity",
+        check_prefix_restore_parity,
+        lambda c: c.decoder_only and not c.attention_window,
+    ),
     ("softmax_f32", check_softmax_f32, lambda c: True),
     ("residual_dtype", check_residual_dtype, lambda c: True),
     ("mask_broadcast", check_mask_broadcast, lambda c: True),

@@ -221,6 +221,61 @@ def speculative_retrace_report(steps: int = 3) -> list[WatchDelta]:
     return sentinel.deltas()
 
 
+def prefix_cache_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state serving WITH the cross-request prefix cache: hits,
+    misses, and partial hits all flow through admission, yet the hot paths
+    — ``_pool_step``, ``_slot_prefill`` (suffix prefill at a traced start),
+    ``_slot_restore`` (block restore at power-of-two padded widths),
+    ``_slot_read_blocks`` (retirement export, one static block width), and
+    ``_pick_pool`` — must compile ZERO new programs after warmup: hit
+    lengths bucket by block count exactly as prompt lengths bucket by
+    ``prefill_len_for``, so no admission outcome may mint a fresh shape."""
+    from transformer_tpu.serve import PrefixCache
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg, params, tok = _tiny_lm_setup()
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+
+    # One shared long prefix plus divergent tails: replays are full hits,
+    # tail variants are partial hits, and the short prompt is a clean miss
+    # — every admission outcome the trie can produce, every round.
+    waves = [
+        [{"prompt": "the quick brown fox jumps"}],
+        [{"prompt": "the quick brown fox jumps"},        # full hit
+         {"prompt": "the quick brown dog"}],             # partial hit
+        [{"prompt": "lazy"},                             # miss
+         {"prompt": "the quick brown fox jumps"}],
+    ]
+
+    def serve(reqs):
+        s = ContinuousScheduler(
+            params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+            prefix_cache=cache,
+        )
+        return s.run(reqs)
+
+    for wave in waves + waves:
+        # TWO warmup passes: the first populates the trie (every wave-0
+        # admission is a miss), the second re-serves the same prompts as
+        # hits/partial hits — covering every restore-pad bucket and
+        # suffix-prefill bucket steady state will see (bounded compile
+        # sets, not steady-state retraces — the budget guards the
+        # per-admission/per-step paths).
+        serve([dict(r) for r in wave])
+    sentinel = RetraceSentinel()
+    sentinel.watch("decode_step(_pool_step)", sched._pool_step, budget=0)
+    sentinel.watch("_slot_prefill", sched._slot_prefill, budget=0)
+    sentinel.watch("restore(_slot_restore)", sched._slot_restore, budget=0)
+    sentinel.watch("export(_slot_read_blocks)", sched._slot_read_blocks, budget=0)
+    sentinel.watch("pick(_pick_pool)", sched._pick_pool, budget=0)
+    sentinel.snapshot()
+    for i in range(steps):
+        out = serve([dict(r) for r in waves[i % len(waves)]])
+        assert all("continuation" in r for r in out), out
+    return sentinel.deltas()
+
+
 def train_retrace_report(steps: int = 3) -> list[WatchDelta]:
     """Steady-state training: one warmup step compiles; ``steps`` more
     same-shaped steps must not."""

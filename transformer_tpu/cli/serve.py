@@ -40,7 +40,12 @@ path. ``--speculate_k`` adds speculative decoding on the same slot pool:
 a drafter (``--draft_checkpoint`` model or the default n-gram
 prompt-lookup, ``--draft_ngram``) proposes candidate tokens and one
 multi-token verify forward scores them all — more tokens per
-bandwidth-bound forward, byte-identical greedy answers. See
+bandwidth-bound forward, byte-identical greedy answers.
+``--prefix_cache_mb`` adds a cross-request prefix KV cache: completed
+prompt KV is kept host-side in a radix trie of token-aligned blocks
+(``--prefix_block``), and a new request restores its longest shared
+prefix straight into its slot instead of re-forwarding it — shared
+system prompts and retry storms stop paying prefill. See
 docs/SERVING.md.
 
 Telemetry: ``--metrics_jsonl`` streams structured events (per-request spans,
@@ -104,6 +109,19 @@ def define_serve_flags() -> None:
         "draft_ngram", 3,
         "longest suffix n-gram the model-free drafter matches against "
         "earlier context (only used when --draft_checkpoint is unset)")
+    flags.DEFINE_integer(
+        "prefix_cache_mb", 0,
+        "host-memory byte budget (MiB) for the cross-request prefix KV "
+        "cache on the continuous-batching path: completed prompt KV is "
+        "stored as token-aligned blocks in a radix trie and new requests "
+        "restore their longest shared prefix instead of re-forwarding it "
+        "(greedy answers byte-identical). 0 = off. Incompatible with "
+        "attention_window (rolling caches evict absolute-position rows)")
+    flags.DEFINE_integer(
+        "prefix_block", 16,
+        "prefix-cache block granularity in tokens: prompts share stored KV "
+        "in units of this many positions (smaller = finer matching, more "
+        "trie overhead)")
 
 
 def _parse_line(line: str, model_cfg) -> dict:
@@ -385,7 +403,11 @@ def main(argv) -> None:
     q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_batch) * 8)
     threading.Thread(target=_stdin_reader, args=(q,), daemon=True).start()
     if continuous:
-        from transformer_tpu.serve import ContinuousScheduler, drafter_from_flags
+        from transformer_tpu.serve import (
+            ContinuousScheduler,
+            PrefixCache,
+            drafter_from_flags,
+        )
 
         drafter = None
         if FLAGS.speculate_k > 0:
@@ -394,6 +416,13 @@ def main(argv) -> None:
                 FLAGS.serve_max_total or model_cfg.max_position + 1,
                 eos_id=tgt_tok.eos_id,
                 target_vocab_size=model_cfg.target_vocab_size,
+            )
+        prefix_cache = None
+        if FLAGS.prefix_cache_mb > 0:
+            prefix_cache = PrefixCache(
+                model_cfg,
+                block_tokens=FLAGS.prefix_block,
+                budget_mb=FLAGS.prefix_cache_mb,
             )
         sched = ContinuousScheduler(
             params, model_cfg, tgt_tok,
@@ -404,6 +433,7 @@ def main(argv) -> None:
             telemetry=telemetry,
             speculate_k=FLAGS.speculate_k,
             drafter=drafter,
+            prefix_cache=prefix_cache,
         )
         serve_continuous(q, sched, model_cfg, telemetry=telemetry)
         if telemetry is not None:

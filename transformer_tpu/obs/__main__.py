@@ -72,6 +72,20 @@ def summarize_events(events: list[dict]) -> dict:
             report["serve"]["tokens_per_forward"] = round(
                 gen_tokens / forwards, 3
             )
+        # Prefix cache: prompt tokens restored from stored KV blocks
+        # instead of a prefill forward. Spans carry prefix_hit_tokens
+        # (zero on misses) only for requests that PARTICIPATED, so the
+        # hit rate's denominator excludes opted-out traffic.
+        prefix_reqs = [r for r in ok if "prefix_hit_tokens" in r]
+        if prefix_reqs:
+            hit = sum(int(r["prefix_hit_tokens"]) for r in prefix_reqs)
+            prompt = sum(int(r.get("prompt_tokens", 0)) for r in prefix_reqs)
+            report["serve"]["prefix_cache"] = {
+                "requests": len(prefix_reqs),
+                "hit_tokens": hit,
+                "prompt_tokens": prompt,
+                "hit_rate": round(hit / prompt, 4) if prompt else None,
+            }
         drafted = sum(int(r.get("drafted", 0)) for r in ok)
         if drafted:
             accepted = sum(int(r.get("draft_accepted", 0)) for r in ok)
@@ -197,6 +211,16 @@ def render_text(report: dict) -> str:
         if serve.get("tokens_per_forward"):
             lines.append(
                 f"  tokens/forward: {serve['tokens_per_forward']}"
+            )
+        pc = serve.get("prefix_cache")
+        if pc:
+            rate = (
+                f" ({pc['hit_rate'] * 100:.1f}% hit rate)"
+                if pc.get("hit_rate") is not None else ""
+            )
+            lines.append(
+                f"  prefix cache: {pc['hit_tokens']}/{pc['prompt_tokens']} "
+                f"prompt tokens reused{rate} over {pc['requests']} requests"
             )
         spec = serve.get("speculative")
         if spec:

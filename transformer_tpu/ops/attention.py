@@ -415,6 +415,68 @@ def _quantize_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q.astype(jnp.int8), scale.astype(jnp.float32)
 
 
+def kv_buffer_keys(cache: dict[str, Any]) -> tuple[str, ...]:
+    """The cache keys that hold per-position KV rows, in the cache's own
+    storage layout: ``("k", "v")`` for plain caches, plus the fp32
+    ``k_scale``/``v_scale`` rows for int8-quantized ones. The ONE listing of
+    the layout's buffer names — ``_store_kv``, ``slice_kv_blocks``, and
+    ``insert_kv_blocks`` all iterate it, so a future layout (new buffer key)
+    cannot desynchronize the write, export, and restore paths."""
+    if "k_scale" in cache:
+        return ("k", "k_scale", "v", "v_scale")
+    return ("k", "v")
+
+
+def _require_positional_buffers(cache: dict[str, Any], op: str) -> None:
+    """Reject rolling-window caches from operations that address buffer rows
+    by absolute position. A rolling buffer stores position ``p`` at slot
+    ``p % buf_len`` and EVICTS on wrap — row ranges are neither stable nor
+    complete, so block export/restore (prefix cache) and index rollback
+    (speculation) are structurally unsound there. Shared by
+    ``rollback_cache`` / ``slice_kv_blocks`` / ``insert_kv_blocks`` so every
+    random-access path refuses with the same policy."""
+    if "rolling" in cache:
+        raise ValueError(
+            f"{op} cannot address a rolling-window cache by position: the "
+            "window buffer evicts rows on wrap (slot p % buf_len), so "
+            "absolute-position rows are neither stable nor complete — serve "
+            "this config without attention_window"
+        )
+
+
+def slice_kv_blocks(cache: dict[str, Any], start, n: int) -> dict[str, Any]:
+    """Read buffer rows ``[start, start + n)`` of every KV buffer — the
+    block-granular EXPORT half of the prefix cache's round trip. Rows come
+    out in the cache's own storage layout (int8 codes and their fp32 scales
+    slice as stored, bf16 slices as bf16), so an exported block re-inserted
+    by ``insert_kv_blocks`` is bit-identical to the original write — the
+    invariant that makes cross-request KV reuse byte-transparent. ``n`` must
+    be static (it is a shape); ``start`` may be traced."""
+    _require_positional_buffers(cache, "slice_kv_blocks")
+    return {
+        key: jax.lax.dynamic_slice_in_dim(cache[key], start, n, axis=1)
+        for key in kv_buffer_keys(cache)
+    }
+
+
+def insert_kv_blocks(
+    cache: dict[str, Any], blocks: dict[str, Any], start
+) -> dict[str, Any]:
+    """Write exported KV rows back at buffer rows ``[start, start +
+    blocks_len)`` — the RESTORE half of ``slice_kv_blocks``. Blocks are
+    already in storage layout, so this is a pure ``dynamic_update_slice``
+    per buffer: no re-quantization, no dtype conversion, bit-identical to
+    the rows the donor cache held. ``index`` (and any other bookkeeping) is
+    left untouched — callers own it, same contract as ``_store_kv``."""
+    _require_positional_buffers(cache, "insert_kv_blocks")
+    new = dict(cache)
+    for key in kv_buffer_keys(cache):
+        new[key] = jax.lax.dynamic_update_slice_in_dim(
+            cache[key], blocks[key], start, axis=1
+        )
+    return new
+
+
 def _store_kv(cache, k, v, write):
     """Write new (B, S_q, H, D) k/v into a decode cache's buffers via
     ``write(buf, val) -> buf`` (the caller picks the scatter: rolling slots
@@ -431,12 +493,8 @@ def _store_kv(cache, k, v, write):
     if "k_scale" in cache:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
-        new = {
-            "k": write(cache["k"], kq),
-            "k_scale": write(cache["k_scale"], ks),
-            "v": write(cache["v"], vq),
-            "v_scale": write(cache["v_scale"], vs),
-        }
+        vals = {"k": kq, "k_scale": ks, "v": vq, "v_scale": vs}
+        new = {key: write(cache[key], vals[key]) for key in kv_buffer_keys(cache)}
         dtype = k.dtype
         return (
             new,
@@ -460,17 +518,14 @@ def rollback_cache(cache: dict[str, Any], index) -> dict[str, Any]:
     them in place (the int8 variant re-quantizes the row, so stale scales
     can never pair with fresh codes).
 
-    Rolling-window caches are REJECTED: a speculative write at position
-    ``p`` evicts slot ``p % buf_len`` — a position that may still be inside
-    the window after rollback — so index reset cannot restore their state.
-    Gate speculation off for ``attention_window`` configs instead.
+    Rolling-window caches are REJECTED (``_require_positional_buffers``, the
+    same policy gate the prefix cache's block slice/insert uses): a
+    speculative write at position ``p`` evicts slot ``p % buf_len`` — a
+    position that may still be inside the window after rollback — so index
+    reset cannot restore their state. Gate speculation off for
+    ``attention_window`` configs instead.
     """
-    if "rolling" in cache:
-        raise ValueError(
-            "rollback_cache cannot restore a rolling-window cache: "
-            "speculative writes evict slots that remain in-window after "
-            "rollback (disable speculation for attention_window configs)"
-        )
+    _require_positional_buffers(cache, "rollback_cache")
     return dict(cache, index=jnp.asarray(index, jnp.int32))
 
 

@@ -1,8 +1,11 @@
 """Serving-side scheduling: continuous (in-flight) batching over a fixed
-pool of KV-cache slots (``transformer_tpu/serve/scheduler.py``) and
+pool of KV-cache slots (``transformer_tpu/serve/scheduler.py``),
 speculative decoding — draft/verify/rollback on that pool
-(``transformer_tpu/serve/speculative.py``)."""
+(``transformer_tpu/serve/speculative.py``) — and the cross-request prefix
+KV cache — radix-trie prompt reuse feeding slot admission
+(``transformer_tpu/serve/prefix_cache.py``)."""
 
+from transformer_tpu.serve.prefix_cache import PrefixCache, PrefixHit
 from transformer_tpu.serve.scheduler import ContinuousScheduler, SlotPool
 from transformer_tpu.serve.speculative import (
     ModelDrafter,
@@ -13,6 +16,8 @@ from transformer_tpu.serve.speculative import (
 
 __all__ = [
     "ContinuousScheduler",
+    "PrefixCache",
+    "PrefixHit",
     "SlotPool",
     "ModelDrafter",
     "NgramDrafter",

@@ -1,0 +1,317 @@
+"""Cross-request prefix KV cache: radix-trie prompt reuse for the slot pool.
+
+Real LM serving traffic is dominated by SHARED PREFIXES — a system prompt
+every request carries, few-shot templates, retry storms replaying the same
+context. The paper's decoder pays a full prefill forward for every one of
+those prompts, recomputing K/V the pool computed seconds ago for an
+identical token sequence. The KV cache is the object that makes decode
+cheap ("Fast Transformer Decoding", Shazeer, arXiv:1911.02150); this module
+extends that economy ACROSS requests, in the Mesh-TensorFlow spirit
+(PAPERS.md) of restructuring *what* is computed: tokens whose KV already
+exists are never re-forwarded.
+
+Mechanics:
+
+- **Blocks.** Completed prefill KV is stored on the HOST as fixed-size,
+  token-aligned blocks (``block_tokens`` positions each), per decoder
+  layer, in the cache's OWN storage layout (bf16 rows as bf16, int8 codes
+  with their fp32 scales, GQA at the kv-head count) — sliced out by
+  ``ops.attention.slice_kv_blocks`` and restored by ``insert_kv_blocks``,
+  so a restore is bit-identical to the donor's original write and greedy
+  answers are byte-identical cache on/off.
+- **Radix trie over token ids.** Blocks are indexed by a trie whose edges
+  are ``block_tokens``-wide token tuples: a node at depth ``d`` holds the
+  KV block for positions ``[d*B, (d+1)*B)`` of every prompt that shares
+  that exact token prefix. Matching is a root walk — the longest
+  block-aligned shared prefix falls out in O(prefix/B) dict hops, and two
+  prompts share storage for exactly the blocks their token ids agree on.
+- **Admission.** ``ContinuousScheduler._start`` matches the new prompt,
+  copies the matched blocks into the slot's device cache (one
+  ``device_put`` + ``dynamic_update_slice`` program — NO model forward),
+  and chunk-prefills only the unmatched suffix. Matched widths are padded
+  to power-of-two block counts so the restore program compiles
+  O(log(max_total / B)) times total, never per hit length (pinned by
+  ``analysis.retrace.prefix_cache_retrace_report``).
+- **Retirement.** The retiring slot's prompt-region KV (positions
+  ``[0, floor(prompt_len / B) * B)``) is sliced into blocks and inserted —
+  only blocks the trie does not already hold are fetched off the device.
+- **Eviction.** Refcounted LRU under a byte budget (``--prefix_cache_mb``):
+  blocks pinned by an in-progress admission are never evicted, and only
+  childless nodes are candidates (evicting an interior node would orphan
+  its descendants — a trie walk could never reach them again).
+
+Rolling-window caches are refused at construction (same policy as
+speculative rollback): a rolling buffer stores position ``p`` at slot
+``p % buf_len`` and evicts on wrap, so absolute-position block rows are
+neither stable nor complete. Everything else composes: chunked prefill
+(the suffix path IS chunked prefill), int8/GQA layouts (blocks store the
+layout verbatim), speculative decoding (restore only touches the prompt
+region; speculation only writes past it), per-request opt-out
+(``"cache_prefix": false`` neither reads nor feeds the cache).
+
+Host-side and single-threaded by design — the scheduler drives it at
+admission/retirement boundaries that already sync; nothing here touches
+the jitted hot path's shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+from transformer_tpu.config import ModelConfig
+
+
+class _Node:
+    """One trie node = one KV block: per-layer buffer rows for the
+    ``block_tokens`` positions this node's depth covers, for every prompt
+    sharing the root-to-here token path."""
+
+    __slots__ = ("children", "parent", "edge", "blocks", "nbytes", "last_used", "refs")
+
+    def __init__(self, parent: "_Node | None", edge: tuple[int, ...]):
+        self.children: dict[tuple[int, ...], _Node] = {}
+        self.parent = parent
+        self.edge = edge
+        self.blocks: list[dict[str, np.ndarray]] | None = None  # None = root
+        self.nbytes = 0
+        self.last_used = 0
+        self.refs = 0
+
+
+@dataclasses.dataclass
+class PrefixHit:
+    """A pinned match: ``tokens`` block-aligned prefix positions whose KV
+    the trie holds. The matched nodes stay refcounted (eviction-proof)
+    until ``release()`` — the scheduler releases right after the restore
+    program is dispatched."""
+
+    tokens: int
+    _nodes: list[_Node]
+    _cache: "PrefixCache"
+
+    def stacked(self, cap_tokens: int) -> list[dict[str, np.ndarray]] | None:
+        """Matched blocks concatenated along the position axis and padded to
+        a POWER-OF-TWO block count (clamped to ``cap_tokens``, the slot
+        buffer length) — the static width that keeps the jitted restore
+        program's compile set O(log(max_total / block)) instead of one per
+        distinct hit length. Pad rows are zeros: they land at positions
+        ``>= tokens``, which the offset causal mask already hides and the
+        suffix prefill overwrites in place."""
+        if not self._nodes:
+            return None
+        B = self._cache.block_tokens
+        blocks = len(self._nodes)
+        padded = 1
+        while padded < blocks:
+            padded *= 2
+        width = min(padded * B, cap_tokens)
+        out: list[dict[str, np.ndarray]] = []
+        for layer in range(len(self._nodes[0].blocks)):
+            per_key: dict[str, np.ndarray] = {}
+            for key in self._nodes[0].blocks[layer]:
+                parts = [n.blocks[layer][key] for n in self._nodes]
+                if width > blocks * B:
+                    shape = list(parts[0].shape)
+                    shape[1] = width - blocks * B
+                    parts.append(np.zeros(shape, dtype=parts[0].dtype))
+                per_key[key] = np.concatenate(parts, axis=1)
+            out.append(per_key)
+        return out
+
+    def release(self) -> None:
+        for node in self._nodes:
+            node.refs -= 1
+        self._nodes = []
+
+
+class PrefixCache:
+    """Host-side radix-trie store of prompt-prefix KV blocks.
+
+    ``match``/``insert`` are the whole scheduler-facing surface; both are
+    plain host code (numpy + dicts) driven at admission/retirement
+    boundaries. ``stats`` is cache-level introspection (block/eviction
+    counts); hit-token accounting lives in the SCHEDULER's stats and
+    telemetry counters (``serve_prefix_hit_tokens_total``), which count
+    only hits whose admission actually succeeded.
+
+    SCOPE: one cache per serving process — blocks are keyed by token ids
+    alone, so every scheduler sharing an instance must serve the SAME
+    params and cache layout (a serve process has exactly one of each;
+    sharing across different weights would silently restore the wrong
+    model's K/V)."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        *,
+        block_tokens: int = 16,
+        budget_mb: int = 64,
+    ):
+        if cfg.attention_window:
+            raise ValueError(
+                "prefix cache cannot serve a rolling-window cache "
+                "(attention_window): block restore addresses buffer rows by "
+                "absolute position, which a rolling buffer evicts on wrap — "
+                "the same policy that refuses speculative rollback"
+            )
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        if budget_mb < 1:
+            raise ValueError(f"budget_mb must be >= 1, got {budget_mb}")
+        self.cfg = cfg
+        self.block_tokens = block_tokens
+        self.budget_bytes = budget_mb * (1 << 20)
+        self._root = _Node(None, ())
+        self._clock = 0
+        self._bytes = 0
+        self._bytes_per_block = 0  # learned from the first inserted block
+        self.stats = {
+            "blocks": 0,
+            "inserted_blocks": 0,
+            "evicted_blocks": 0,
+        }
+
+    # ---- matching ---------------------------------------------------------
+
+    def match(self, ids: Sequence[int]) -> PrefixHit:
+        """Longest block-aligned prefix of ``ids`` the trie holds. Callers
+        pass the prompt MINUS its last token (``ids[:L-1]``): at least one
+        token must still go through the model forward — the admission pick
+        needs next-token logits, and a restore produces none."""
+        self._clock += 1
+        B = self.block_tokens
+        node, nodes = self._root, []
+        for j in range(len(ids) // B):
+            child = node.children.get(tuple(ids[j * B : (j + 1) * B]))
+            if child is None:
+                break
+            child.last_used = self._clock
+            child.refs += 1
+            nodes.append(child)
+            node = child
+        return PrefixHit(tokens=len(nodes) * B, _nodes=nodes, _cache=self)
+
+    # ---- insertion + eviction --------------------------------------------
+
+    def insert(
+        self,
+        ids: Sequence[int],
+        n_tokens: int,
+        read_block: Callable[[int], list[dict[str, np.ndarray]]],
+    ) -> int:
+        """Store the first ``floor(n_tokens / B) * B`` positions of ``ids``,
+        fetching ONLY the blocks the trie is missing via ``read_block(start)
+        -> per-layer host buffers`` (the scheduler's jitted slot slice).
+        Evicts LRU unpinned leaves to stay under the byte budget; a block
+        that cannot fit (everything else pinned or interior) is dropped,
+        never force-stored. Returns the number of blocks evicted."""
+        self._clock += 1
+        B = self.block_tokens
+        node, evicted, pinned = self._root, 0, []
+        try:
+            for j in range(n_tokens // B):
+                key = tuple(ids[j * B : (j + 1) * B])
+                child = node.children.get(key)
+                if child is not None:
+                    child.last_used = self._clock
+                else:
+                    if self._bytes_per_block and not self._can_fit(
+                        self._bytes_per_block
+                    ):
+                        break  # budget unreachable: don't even fetch
+                    blocks = [
+                        {k: np.asarray(v) for k, v in layer.items()}
+                        for layer in read_block(j * B)
+                    ]
+                    nbytes = sum(
+                        a.nbytes for layer in blocks for a in layer.values()
+                    )
+                    self._bytes_per_block = nbytes
+                    freed = self._make_room(nbytes)
+                    if freed is None:
+                        break  # budget unreachable right now: drop the tail
+                    evicted += freed
+                    child = _Node(node, key)
+                    child.blocks = blocks
+                    child.nbytes = nbytes
+                    child.last_used = self._clock
+                    node.children[key] = child
+                    self._bytes += nbytes
+                    self.stats["blocks"] += 1
+                    self.stats["inserted_blocks"] += 1
+                # Pin the WHOLE descend path (existing nodes included, not
+                # just freshly created ones) until this insert finishes: the
+                # current node is a childless leaf right up to the moment
+                # its child is attached, so an unpinned one could be evicted
+                # by the next block's _make_room — and the new child would
+                # then hang off a detached parent, unreachable by any match
+                # yet still counted in the byte budget.
+                child.refs += 1
+                pinned.append(child)
+                node = child
+        finally:
+            for child in pinned:
+                child.refs -= 1
+        self.stats["evicted_blocks"] += evicted
+        return evicted
+
+    def _can_fit(self, nbytes: int) -> bool:
+        """Whether ``_make_room`` could possibly admit ``nbytes`` more:
+        budget headroom plus everything its leaf-first cascade could evict
+        (a node is unevictable iff it or ANY descendant is pinned — an
+        unpinned chain evicts leaf by leaf). Checked BEFORE fetching a
+        block off the device so an unreachable budget never pays the
+        device->host copy it is about to drop."""
+        if nbytes > self.budget_bytes:
+            return False
+
+        def retained(n: _Node) -> int:
+            kept = sum(retained(c) for c in n.children.values())
+            if kept or n.refs:
+                kept += n.nbytes
+            return kept
+
+        return retained(self._root) + nbytes <= self.budget_bytes
+
+    def _make_room(self, nbytes: int) -> int | None:
+        """Evict LRU unpinned childless nodes until ``nbytes`` more fits
+        under the budget. Returns blocks evicted, or None when the budget
+        cannot be met (every candidate pinned/interior, or the block alone
+        exceeds the whole budget). O(n) scan per eviction — the trie holds
+        at most budget/block_bytes nodes, and this runs at retirement
+        boundaries, never on the decode hot path."""
+        if nbytes > self.budget_bytes:
+            return None
+        evicted = 0
+        while self._bytes + nbytes > self.budget_bytes:
+            victim = None
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if (
+                    n.blocks is not None
+                    and not n.children
+                    and n.refs == 0
+                    and (victim is None or n.last_used < victim.last_used)
+                ):
+                    victim = n
+            if victim is None:
+                return None
+            del victim.parent.children[victim.edge]
+            self._bytes -= victim.nbytes
+            self.stats["blocks"] -= 1
+            evicted += 1
+        return evicted
+
+    # ---- introspection ----------------------------------------------------
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def block_count(self) -> int:
+        return self.stats["blocks"]

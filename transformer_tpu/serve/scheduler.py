@@ -19,6 +19,14 @@ pool of KV-cache slots:
   position mask zeroes anything at positions the new request has not
   written). Prefill lengths are bucketed (``prefill_len_for``) so serving
   never recompiles per prompt length.
+- **Cross-request prefix reuse** (``prefix_cache=``, ``serve/
+  prefix_cache.py``): admission first walks a host-side radix trie of
+  stored KV blocks for the longest block-aligned prefix an earlier request
+  already computed; matched blocks are copied into the slot's cache with
+  one jitted ``_slot_restore`` (no model forward) and only the unmatched
+  suffix is chunk-prefilled. Retirement slices the slot's prompt-region KV
+  back into the trie. Greedy answers are byte-identical cache on/off;
+  per-request ``"cache_prefix": false`` opts out of both directions.
 - **Retirement at step boundaries**: a slot that emits EOS (or exhausts its
   ``max_new`` budget) is retired and recycled at the next step boundary; the
   remaining slots never wait for it.
@@ -70,6 +78,7 @@ from transformer_tpu.models.transformer import (
     transformer_prefill,
     transformer_verify,
 )
+from transformer_tpu.ops.attention import insert_kv_blocks, slice_kv_blocks
 from transformer_tpu.serve.speculative import (
     NgramDrafter,
     build_verify_row,
@@ -139,10 +148,16 @@ def _pool_rollback(pool_caches, delta):
 
 
 @partial(jax.jit, static_argnames=("cfg", "chunk"))
-def _slot_prefill(params, pool_caches, slot, prompt, cfg: ModelConfig, chunk: int):
-    """Prefill a (1, n) prompt into slot ``slot`` (traced — no recompile per
-    slot), resetting its cache index to 0. Returns ((1, V) logits for the
-    next position, updated pool caches).
+def _slot_prefill(
+    params, pool_caches, slot, prompt, start, cfg: ModelConfig, chunk: int
+):
+    """Prefill a (1, n) prompt suffix into slot ``slot`` at absolute
+    positions ``start .. start + n - 1`` (slot AND start traced — no
+    recompile per slot or per prefix-cache hit length), resetting the
+    slot's cache index to ``start``. ``start`` is 0 for a plain admission;
+    a prefix-cache hit restores ``start`` positions first
+    (``_slot_restore``) and prefills only the unmatched suffix from there.
+    Returns ((1, V) logits for the next position, updated pool caches).
 
     NOT donated, unlike ``_pool_step``: an execution-time failure here (e.g.
     device OOM on a long prompt) is answered as a per-request admission
@@ -151,14 +166,45 @@ def _slot_prefill(params, pool_caches, slot, prompt, cfg: ModelConfig, chunk: in
     every in-flight request. ``_pool_step`` failures are fatal anyway, so
     the hot per-token path keeps the in-place donation win."""
     slot_caches = jax.tree.map(lambda x: x[slot], pool_caches)
-    slot_caches = [dict(c, index=jnp.int32(0)) for c in slot_caches]
+    slot_caches = [dict(c, index=jnp.asarray(start, jnp.int32)) for c in slot_caches]
     logits, slot_caches = transformer_prefill(
-        params, prompt, None, None, slot_caches, 0, cfg, chunk=chunk
+        params, prompt, None, None, slot_caches, start, cfg, chunk=chunk
     )
     pool_caches = jax.tree.map(
         lambda pool, s: pool.at[slot].set(s), pool_caches, slot_caches
     )
     return logits, pool_caches
+
+
+@jax.jit
+def _slot_restore(pool_caches, slot, blocks):
+    """Copy prefix-cache blocks (per-layer host buffers, already
+    ``device_put`` by jit's argument transfer) into slot ``slot`` at
+    positions ``[0, width)`` — the NO-FORWARD half of a cache-hit
+    admission. ``blocks`` is padded to a power-of-two block count
+    (``PrefixHit.stacked``), so the compile set is O(log(max_total /
+    block)), never one per hit length; zero pad rows land at positions the
+    offset causal mask hides until the suffix prefill overwrites them.
+    Cache ``index`` is untouched here — ``_slot_prefill`` resets it to the
+    restored width when it ingests the suffix. NOT donated, for the same
+    per-request admission-error isolation as ``_slot_prefill``."""
+    slot_caches = jax.tree.map(lambda x: x[slot], pool_caches)
+    slot_caches = [
+        insert_kv_blocks(c, b, 0) for c, b in zip(slot_caches, blocks)
+    ]
+    return jax.tree.map(
+        lambda pool, s: pool.at[slot].set(s), pool_caches, slot_caches
+    )
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _slot_read_blocks(pool_caches, slot, start, n: int):
+    """Read ``n`` KV rows at ``[start, start + n)`` from slot ``slot`` in
+    storage layout (``ops.attention.slice_kv_blocks``) — the retirement-side
+    export the prefix cache host-copies into its trie. ``n`` is the static
+    block width, so this compiles ONCE; ``start``/``slot`` are traced."""
+    slot_caches = jax.tree.map(lambda x: x[slot], pool_caches)
+    return [slice_kv_blocks(c, start, n) for c in slot_caches]
 
 
 @partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))
@@ -237,6 +283,12 @@ class _Active:
     drafted: int = 0
     accepted: int = 0
     forwards: int = 0          # target-model decode forwards this request rode
+    # Prefix cache: whether this request participates (per-request
+    # "cache_prefix": false opts out of BOTH reading and feeding the trie)
+    # and how many prompt positions were restored from stored blocks
+    # instead of a model forward (span field; hit-rate in obs summarize).
+    use_prefix: bool = False
+    prefix_hit: int = 0
     # Span clock (host perf_counter; None until the edge is reached):
     # enqueue -> admit -> prefill-dispatched -> first token -> finish.
     t_enqueue: float = 0.0
@@ -287,6 +339,7 @@ class ContinuousScheduler:
         telemetry=None,
         speculate_k: int = 0,
         drafter=None,
+        prefix_cache=None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -301,7 +354,18 @@ class ContinuousScheduler:
                 "cache (attention_window evicts slots that stay in-window "
                 "after rollback); serve this config with speculate_k=0"
             )
+        if prefix_cache is not None and cfg.attention_window:
+            # Mirrors the speculative refusal above: block restore addresses
+            # cache rows by absolute position, which a rolling buffer evicts
+            # on wrap (PrefixCache's own constructor refuses too — this
+            # guards a cache built against a different config).
+            raise ValueError(
+                "prefix cache cannot serve a rolling-window cache "
+                "(attention_window evicts absolute-position rows on wrap); "
+                "serve this config without --prefix_cache_mb"
+            )
         self.params, self.cfg, self.tok = params, cfg, tokenizer
+        self.prefix_cache = prefix_cache
         self.prefill_chunk = prefill_chunk
         self.default_max_new = default_max_new
         self.max_total = max_total or cfg.max_position + 1
@@ -325,7 +389,14 @@ class ContinuousScheduler:
         self._done: dict[int, dict] = {}
         self._next_order = 0
         self._emit_next = 0
-        self.stats = {"admitted": 0, "steps": 0, "max_active": 0}
+        self.stats = {
+            "admitted": 0, "steps": 0, "max_active": 0,
+            # Prefix-cache accounting (host-side, filled at admission):
+            # prompt tokens seen, tokens restored from stored blocks, and
+            # the prefill forwards actually dispatched — decode_bench's
+            # --prefix_reuse sweep derives "forwards saved" from these.
+            "prompt_tokens": 0, "prefix_hit_tokens": 0, "prefill_forwards": 0,
+        }
         # Telemetry (obs.Telemetry | None) records host-side scalars only, at
         # the step/admission boundaries that already exist — answers stay
         # byte-identical (tests/test_obs.py pins this) and the decode hot
@@ -374,6 +445,14 @@ class ContinuousScheduler:
                 self._m_spec_rejected = reg.counter(
                     "serve_spec_rejected_total",
                     "draft tokens rejected or wasted past a mismatch")
+            if prefix_cache is not None:
+                self._m_prefix_hit = reg.counter(
+                    "serve_prefix_hit_tokens_total",
+                    "prompt tokens restored from the prefix cache "
+                    "(no model forward)")
+                self._m_prefix_evicted = reg.counter(
+                    "serve_prefix_evicted_blocks_total",
+                    "prefix-cache KV blocks evicted under the byte budget")
 
     # ---- request intake ---------------------------------------------------
 
@@ -487,19 +566,60 @@ class ContinuousScheduler:
                 f"top_k={top_k} exceeds the vocab size "
                 f"{self.cfg.target_vocab_size}"
             )
-
-        n = prefill_len_for(L, self.prefill_chunk)
+        if req.get("cache_prefix") and self.cfg.attention_window:
+            # An EXPLICIT cache_prefix=true on a rolling-window server is a
+            # contract the server cannot honor (block restore addresses
+            # rows by absolute position; the window buffer evicts them on
+            # wrap) — answer this request alone with a structured error,
+            # before any slot is popped, mirroring the speculative-rollback
+            # refusal. Absent/false composes fine: the request just
+            # prefills normally.
+            raise ValueError(
+                "cache_prefix=true cannot be honored: this server runs a "
+                "rolling-window cache (attention_window), which the prefix "
+                "cache refuses — resend with cache_prefix=false or serve "
+                "without attention_window"
+            )
+        use_prefix = self.prefix_cache is not None and bool(
+            req.get("cache_prefix", True)
+        )
+        hit = None
+        m = 0
+        if use_prefix:
+            # Match the prompt MINUS its last token: at least one token must
+            # go through the model forward — the admission pick needs
+            # next-token logits, which a block restore cannot produce.
+            hit = self.prefix_cache.match(ids[: L - 1])
+            m = hit.tokens
+        n_suffix = prefill_len_for(L - m, self.prefill_chunk)
+        n = m + n_suffix
         slot = self._free.pop()
         t_admit = time.perf_counter()
         try:
+            if m:
+                self.pool.caches = _slot_restore(
+                    self.pool.caches, jnp.int32(slot),
+                    hit.stacked(self.max_total + self.speculate_k),
+                )
             logits, self.pool.caches = _slot_prefill(
                 self.params, self.pool.caches, jnp.int32(slot),
-                jnp.asarray([ids[:n]], jnp.int32), self.cfg,
+                jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m), self.cfg,
                 self.prefill_chunk,
             )
         except Exception:
             self._free.append(slot)
             raise
+        finally:
+            if hit is not None:
+                hit.release()
+        self.stats["prompt_tokens"] += L
+        self.stats["prefix_hit_tokens"] += m
+        chunk = self.prefill_chunk
+        self.stats["prefill_forwards"] += (
+            -(-n_suffix // chunk) if chunk > 0 else 1
+        )
+        if m and self._tel is not None and self.prefix_cache is not None:
+            self._m_prefix_hit.inc(m)
         spec = bool(self.speculate_k) and bool(req.get("speculate", True))
         st = _Active(
             order=order, ids=ids, prompt_len=L, pos=n, cur=PAD_ID,
@@ -507,6 +627,7 @@ class ContinuousScheduler:
             key=np.asarray(jax.random.PRNGKey(seed)),
             sample=sample, temperature=temperature, top_k=top_k, top_p=top_p,
             seed=seed, spec=spec,
+            use_prefix=use_prefix, prefix_hit=m,
             dstate=(
                 self.drafter.start(ids) if spec and self.drafter is not None
                 else None
@@ -773,6 +894,31 @@ class ContinuousScheduler:
             st.cur = tokv
 
     def _finish(self, slot: int, st: _Active) -> None:
+        if self.prefix_cache is not None and st.use_prefix:
+            # Feed the trie BEFORE the slot is recycled: slice the slot's
+            # prompt-region KV (block-aligned; the cache's own storage
+            # layout) into blocks. Only blocks the trie is missing are
+            # fetched off the device — a request that fully hit fetches
+            # nothing, and an unfittable budget fetches nothing either
+            # (insert prechecks). Fetches are one fixed-shape dispatch per
+            # missing block on purpose: slicing a whole missing RUN would
+            # mint a compile per run length, trading bounded host syncs at
+            # retirement for unbounded recompiles. Opted-out requests
+            # neither read nor feed the cache.
+            B = self.prefix_cache.block_tokens
+            aligned = (st.prompt_len // B) * B
+            if aligned:
+                evicted = self.prefix_cache.insert(
+                    st.ids, aligned,
+                    lambda start: jax.device_get(
+                        _slot_read_blocks(
+                            self.pool.caches, jnp.int32(slot),
+                            jnp.int32(start), B,
+                        )
+                    ),
+                )
+                if evicted and self._tel is not None:
+                    self._m_prefix_evicted.inc(evicted)
         text = _detokenize_rows(
             np.asarray([st.emitted], np.int32) if st.emitted
             else np.zeros((1, 0), np.int32),
@@ -799,6 +945,10 @@ class ContinuousScheduler:
             if st.spec:
                 span["drafted"] = st.drafted
                 span["draft_accepted"] = st.accepted
+            if self.prefix_cache is not None and st.use_prefix:
+                # Recorded on MISSES too (0): summarize's hit rate divides
+                # by prompt_tokens over participating requests only.
+                span["prefix_hit_tokens"] = st.prefix_hit
             self._m_queue_s.observe(queue_s)
             self._m_total_s.observe(total_s)
             if st.t_prefill is not None:
