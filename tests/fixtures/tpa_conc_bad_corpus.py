@@ -1,0 +1,90 @@
+"""Seeded KNOWN-BAD corpus for the TPA100-series concurrency rules — one
+violation per rule. Parsed by AST only, never imported/executed; `python -m
+transformer_tpu.analysis concurrency --paths
+tests/fixtures/tpa_conc_bad_corpus.py` must exit NON-zero
+(tests/test_analysis.py pins exactly which codes fire). The twin file
+``tpa_conc_good_corpus.py`` holds the corrected versions and must pass."""
+
+import queue
+import threading
+import time
+
+
+class UnguardedCounter:
+    """TPA101: the scrape thread and the recorder share `hits` with no lock
+    around the recorder's write."""
+
+    def __init__(self):
+        self.hits = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self.scrape_loop, daemon=True)
+        self._thread.start()
+
+    def scrape_loop(self):
+        while True:
+            with self._lock:
+                snapshot = dict(self.hits)
+            print(snapshot)
+
+    def record(self, name):
+        self.hits[name] = 1  # TPA101: unguarded write to lock-guarded state
+
+
+class RefCounter:
+    """TPA104: a non-atomic read-modify-write on a shared refcount."""
+
+    def __init__(self):
+        self.refs = 0
+        self._worker = threading.Thread(target=self.drain, daemon=True)
+
+    def drain(self):
+        while self.refs:
+            time.sleep(0.01)
+
+    def retain(self):
+        self.refs += 1  # TPA104: two threads can both read the old value
+
+
+class TwoLocks:
+    """TPA102 + TPA103: inconsistent guards and a lock-order cycle."""
+
+    def __init__(self):
+        self.items = []
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._loop = threading.Thread(target=self.producer, daemon=True)
+
+    def producer(self):
+        with self._lock_a:
+            self.items.append(1)  # guarded by _lock_a ...
+        with self._lock_a:
+            with self._lock_b:  # ... and A-then-B here ...
+                self.items.append(2)
+
+    def consumer(self):
+        with self._lock_b:
+            self.items.pop()  # TPA102: ... but by _lock_b here
+        with self._lock_b:
+            with self._lock_a:  # TPA103: B-then-A closes the cycle
+                self.items.clear()
+
+
+class SlowCritical:
+    """TPA105: blocking work inside the critical section."""
+
+    def __init__(self):
+        self.pending = []
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self.flush_loop, daemon=True)
+
+    def flush_loop(self):
+        while True:
+            with self._lock:
+                item = self._q.get()  # TPA105: queue.get() under the lock
+                self.pending.append(item)
+
+    def flush_now(self):
+        with self._lock:
+            time.sleep(0.5)  # TPA105: sleep while peers contend
+            self.pending.clear()

@@ -1,0 +1,94 @@
+"""KNOWN-GOOD twin of ``tpa_conc_bad_corpus.py``: the same shapes written
+with a consistent lock discipline. `python -m transformer_tpu.analysis
+concurrency --paths tests/fixtures/tpa_conc_good_corpus.py` must exit 0."""
+
+import queue
+import threading
+import time
+
+
+class GuardedCounter:
+    """Every access to `hits` takes the one owning lock."""
+
+    def __init__(self):
+        self.hits = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self.scrape_loop, daemon=True)
+        self._thread.start()
+
+    def scrape_loop(self):
+        while True:
+            with self._lock:
+                snapshot = dict(self.hits)
+            print(snapshot)
+
+    def record(self, name):
+        with self._lock:
+            self.hits[name] = 1
+
+
+class GuardedRefCounter:
+    """The read-modify-write happens inside the lock: no lost updates."""
+
+    def __init__(self):
+        self.refs = 0
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self.drain, daemon=True)
+
+    def drain(self):
+        while True:
+            with self._lock:
+                live = self.refs
+            if not live:
+                return
+            time.sleep(0.01)
+
+    def retain(self):
+        with self._lock:
+            self.refs += 1
+
+
+class OneLock:
+    """One guard for the shared list, one global acquisition order."""
+
+    def __init__(self):
+        self.items = []
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._loop = threading.Thread(target=self.producer, daemon=True)
+
+    def producer(self):
+        with self._lock_a:
+            self.items.append(1)
+        with self._lock_a:
+            with self._lock_b:
+                self.items.append(2)
+
+    def consumer(self):
+        with self._lock_a:
+            self.items.pop()
+        with self._lock_a:
+            with self._lock_b:  # same A-then-B order as producer
+                self.items.clear()
+
+
+class FastCritical:
+    """Blocking work happens outside the critical section; the lock only
+    covers the shared mutation."""
+
+    def __init__(self):
+        self.pending = []
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self.flush_loop, daemon=True)
+
+    def flush_loop(self):
+        while True:
+            item = self._q.get()  # block outside the lock
+            with self._lock:
+                self.pending.append(item)
+
+    def flush_now(self):
+        time.sleep(0.5)  # simulate slow work with no lock held
+        with self._lock:
+            self.pending.clear()

@@ -409,3 +409,502 @@ def test_no_inline_epoch_rng_left():
         if "default_rng((" in p.read_text() and p.name != "seeding.py"
     ]
     assert offenders == [], offenders
+
+
+# --------------------------------------------------------------------------
+# concurrency rules (TPA101-105): every rule gets a must-flag snippet and a
+# must-not-flag twin, mirroring the TPA001-006 cases above
+
+from transformer_tpu.analysis.concurrency import run_concurrency  # noqa: E402
+
+_CONC_BAD_CORPUS = str(_FIXTURES / "tpa_conc_bad_corpus.py")
+_CONC_GOOD_CORPUS = str(_FIXTURES / "tpa_conc_good_corpus.py")
+
+_CONC_HEADER = """\
+    import queue
+    import threading
+    import time
+"""
+
+# (rule, bad snippet, good twin)
+_CONC_CASES = [
+    (
+        "TPA101",  # unguarded shared write
+        _CONC_HEADER + """
+    class Shared:
+        def __init__(self):
+            self.state = {}
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.loop, daemon=True)
+
+        def loop(self):
+            while True:
+                with self._lock:
+                    print(dict(self.state))
+
+        def poke(self):
+            self.state["x"] = 1
+    """,
+        _CONC_HEADER + """
+    class Shared:
+        def __init__(self):
+            self.state = {}
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.loop, daemon=True)
+
+        def loop(self):
+            while True:
+                with self._lock:
+                    print(dict(self.state))
+
+        def poke(self):
+            with self._lock:
+                self.state["x"] = 1
+    """,
+    ),
+    (
+        "TPA101",  # closure scope: Thread(target=<nested def>)
+        _CONC_HEADER + """
+    def pump(items):
+        out = []
+
+        def worker():
+            for x in items:
+                out.append(x)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        out.append("consumer-side")  # racing the worker's appends
+        t.join()
+        return out
+    """,
+        _CONC_HEADER + """
+    def pump(items):
+        out = []
+        q = queue.Queue()
+
+        def worker():
+            for x in items:
+                q.put(x)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        out.append("after-join")  # reads/writes only after the join
+        return out
+    """,
+    ),
+    (
+        "TPA102",  # inconsistent guard choice
+        _CONC_HEADER + """
+    class TwoGuards:
+        def __init__(self):
+            self.n = 0
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self.loop)
+
+        def loop(self):
+            with self._a:
+                self.n = 1
+
+        def other(self):
+            with self._b:
+                self.n = 2
+    """,
+        _CONC_HEADER + """
+    class OneGuard:
+        def __init__(self):
+            self.n = 0
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self.loop)
+
+        def loop(self):
+            with self._a:
+                self.n = 1
+
+        def other(self):
+            with self._a:
+                self.n = 2
+    """,
+    ),
+    (
+        "TPA103",  # lock-order cycle
+        _CONC_HEADER + """
+    class ABBA:
+        def __init__(self):
+            self.x = 0
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self.fwd)
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    self.x = 2
+    """,
+        _CONC_HEADER + """
+    class ABAB:
+        def __init__(self):
+            self.x = 0
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self.fwd)
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    self.x = 1
+
+        def rev(self):
+            with self._a:
+                with self._b:
+                    self.x = 2
+    """,
+    ),
+    (
+        "TPA104",  # non-atomic refcount RMW
+        _CONC_HEADER + """
+    class Refs:
+        def __init__(self):
+            self.refs = 0
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.watch)
+
+        def watch(self):
+            with self._lock:
+                print(self.refs)
+
+        def retain(self):
+            self.refs += 1
+    """,
+        _CONC_HEADER + """
+    class Refs:
+        def __init__(self):
+            self.refs = 0
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.watch)
+
+        def watch(self):
+            with self._lock:
+                print(self.refs)
+
+        def retain(self):
+            with self._lock:
+                self.refs += 1
+    """,
+    ),
+    (
+        "TPA105",  # blocking under lock
+        _CONC_HEADER + """
+    _LOCK = threading.Lock()
+
+    def checkpoint(path, payload):
+        with _LOCK:
+            with open(path, "w") as f:
+                f.write(payload)
+    """,
+        _CONC_HEADER + """
+    _LOCK = threading.Lock()
+
+    def checkpoint(path, payload):
+        with _LOCK:
+            snapshot = str(payload)
+        with open(path, "w") as f:
+            f.write(snapshot)
+    """,
+    ),
+]
+
+
+def _conc_lint(tmp_path, source, name="snippet.py", baseline=None):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return run_concurrency(paths=[str(f)], baseline_path=baseline)
+
+
+@pytest.mark.parametrize(
+    "rule,bad,good", _CONC_CASES,
+    ids=[f"{c[0]}-{i}" for i, c in enumerate(_CONC_CASES)],
+)
+def test_conc_rule_flags_bad_not_good(tmp_path, rule, bad, good):
+    bad_report = _conc_lint(tmp_path, bad, "bad.py")
+    assert rule in [f.code for f in bad_report.findings], (
+        f"expected {rule}, got {[str(f) for f in bad_report.findings]}"
+    )
+    good_report = _conc_lint(tmp_path, good, "good.py")
+    assert good_report.findings == [], [str(f) for f in good_report.findings]
+
+
+def test_conc_inline_suppression(tmp_path):
+    src = _CONC_HEADER + """
+    class Shared:
+        def __init__(self):
+            self.state = {}
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.loop)
+
+        def loop(self):
+            with self._lock:
+                print(dict(self.state))
+
+        def poke(self):
+            self.state["x"] = 1  # tpa: disable=TPA101 — fixture: suppressed
+    """
+    assert _conc_lint(tmp_path, src).findings == []
+
+
+def test_conc_baseline_grandfathers(tmp_path):
+    src = _CONC_CASES[0][1]
+    report = _conc_lint(tmp_path, src, "mod.py")
+    assert len(report.findings) == 1
+    baseline = tmp_path / "conc_baseline.json"
+    write_baseline(report, str(baseline), reason="grandfathered: fixture")
+    again = _conc_lint(tmp_path, src, "mod.py", baseline=str(baseline))
+    assert again.findings == [] and len(again.baselined) == 1
+
+
+def test_conc_sync_objects_not_shared_state(tmp_path):
+    """Queues/Events/locks ARE the synchronization — cross-thread use of
+    them must not be flagged (the prefetch worker's protocol)."""
+    src = _CONC_HEADER + """
+    def drive(items):
+        q = queue.Queue(maxsize=2)
+        stop = threading.Event()
+
+        def worker():
+            for x in items:
+                if stop.is_set():
+                    return
+                q.put(x)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        first = q.get()
+        stop.set()
+        t.join()
+        return first
+    """
+    assert _conc_lint(tmp_path, src).findings == []
+
+
+def test_conc_package_clean():
+    """The shipped tree holds the concurrency bar: zero unbaselined
+    findings (the two justified handoffs are suppressed inline)."""
+    report = run_concurrency()
+    assert report.findings == [], "\n".join(str(f) for f in report.findings)
+
+
+def test_cli_concurrency_exit_codes(capsys):
+    assert analysis_main(["concurrency"]) == 0
+    assert analysis_main(["concurrency", "--paths", _CONC_BAD_CORPUS]) == 1
+    assert analysis_main(["concurrency", "--paths", _CONC_GOOD_CORPUS]) == 0
+    capsys.readouterr()
+
+
+def test_cli_conc_bad_corpus_fires_every_rule(capsys):
+    rc = analysis_main(
+        ["concurrency", "--paths", _CONC_BAD_CORPUS, "--format", "json"]
+    )
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert sorted(payload["counts"]) == [
+        "TPA101", "TPA102", "TPA103", "TPA104", "TPA105",
+    ]
+
+
+# --------------------------------------------------------------------------
+# deterministic interleaving checker
+
+
+def test_schedules_canned_scenarios_clean():
+    """Acceptance criterion: >= 200 distinct interleavings across the
+    canned scenarios, zero invariant violations, zero deadlocks."""
+    from transformer_tpu.analysis.schedules import run_scenarios
+
+    results = run_scenarios()
+    total = sum(r.schedules for r in results)
+    assert total >= 200, f"only {total} interleavings explored"
+    for r in results:
+        assert not r.violations, (r.name, [v.to_dict() for v in r.violations])
+        assert not r.deadlocks, r.name
+    assert {r.name for r in results} == {
+        "prefix_cache_contention", "registry_scrape_vs_create",
+        "prefetch_shutdown", "eventlog_writers",
+    }
+
+
+def test_cli_schedules(capsys):
+    rc = analysis_main(
+        ["schedules", "--scenario", "eventlog_writers",
+         "--max-schedules", "8", "--format", "json"]
+    )
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["ok"] and payload["total_schedules"] == 8
+
+
+def test_scheduler_finds_deadlock():
+    """An AB/BA lock-order scenario must be driven INTO its deadlock by
+    some explored schedule (and reported, not hung)."""
+    from transformer_tpu.analysis import schedules as S
+
+    def setup(sched):
+        a, b = S.DetLock(sched), S.DetLock(sched)
+
+        def fwd():
+            with a:
+                sched.switch_point()
+                with b:
+                    pass
+
+        def rev():
+            with b:
+                sched.switch_point()
+                with a:
+                    pass
+
+        return [fwd, rev], None
+
+    scen = S.Scenario(
+        name="abba", setup=setup, modules=lambda: [],
+        instrument=lambda: [], max_schedules=32,
+    )
+    result = S.explore(scen)
+    assert result.deadlocks > 0
+    assert any(v.kind == "deadlock" for v in result.violations)
+
+
+def test_scheduler_finds_lost_update():
+    """A read-modify-write with no lock must lose an update under some
+    explored interleaving — the TPA104 bug class, demonstrated live."""
+    from transformer_tpu.analysis import schedules as S
+
+    def setup(sched):
+        box = {"n": 0}
+
+        def bump():
+            for _ in range(2):
+                tmp = box["n"]
+                sched.switch_point()  # the preemption window
+                box["n"] = tmp + 1
+
+        def check():
+            assert box["n"] == 4, f"lost update: {box['n']} != 4"
+
+        return [bump, bump], check
+
+    scen = S.Scenario(
+        name="lost_update", setup=setup, modules=lambda: [],
+        instrument=lambda: [], max_schedules=64,
+    )
+    result = S.explore(scen)
+    assert any(v.kind == "invariant" for v in result.violations)
+
+
+def test_scheduler_replays_violation_schedule():
+    """Every recorded decision trace must REPRODUCE its violation when
+    replayed — the property that makes checker reports actionable. The
+    scenario deliberately mixes a DetLock (forced single-runnable points)
+    into the race so the branch-trace indexing is exercised."""
+    from transformer_tpu.analysis import schedules as S
+
+    def setup(sched):
+        lock = S.DetLock(sched)
+        box = {"n": 0, "log": 0}
+
+        def bump():
+            with lock:  # unrelated guarded work: forces blocking points
+                box["log"] += 1
+            tmp = box["n"]
+            sched.switch_point()
+            box["n"] = tmp + 1
+
+        def check():
+            assert box["n"] == 2, "lost"
+
+        return [bump, bump], check
+
+    scen = S.Scenario(
+        name="replay", setup=setup, modules=lambda: [],
+        instrument=lambda: [], max_schedules=64,
+    )
+    result = S.explore(scen)
+    bad = [v for v in result.violations if v.kind == "invariant"]
+    assert bad
+    for v in bad:
+        replay = S._run_one(scen, list(v.schedule), None)
+        assert any(rv.kind == "invariant" for rv in replay.violations), (
+            f"recorded schedule {v.schedule} did not reproduce {v.detail!r}"
+        )
+
+
+@pytest.mark.slow
+def test_registry_scrape_canary_catches_unlocked_iteration():
+    """Revert-the-lock canary: with the PR 3 registry lock's job undone
+    (a lazy, unlocked dict walk in __iter__ — the pre-fix shape), the
+    schedule explorer must catch the scrape-vs-lazy-creation race the
+    lock exists to prevent."""
+    import functools
+
+    import transformer_tpu.obs.registry as regmod
+    from transformer_tpu.analysis import schedules as S
+    from transformer_tpu.obs.registry import MetricsRegistry
+
+    class UnlockedRegistry(MetricsRegistry):
+        def __iter__(self):  # no lock, no snapshot — the reverted bug
+            metrics = []
+            for name in self._metrics:
+                metrics.append(self._metrics[name])
+            return iter(sorted(metrics, key=lambda m: m.name))
+
+    scen = S.Scenario(
+        name="registry_canary",
+        setup=functools.partial(
+            S._scenario_registry, registry_factory=UnlockedRegistry
+        ),
+        modules=lambda: [regmod],
+        instrument=lambda: [regmod.__file__, __file__],
+        max_schedules=64,
+    )
+    result = S.explore(scen)
+    assert any(
+        "dictionary changed size" in v.detail for v in result.violations
+    ), [v.to_dict() for v in result.violations]
+
+
+@pytest.mark.slow
+def test_eventlog_canary_catches_unlocked_split_write():
+    """Revert-the-lock canary for the event log: an unlocked two-part
+    write (payload, then newline — the torn-JSONL shape) must produce an
+    interleaving whose output no longer parses line-per-event."""
+    import functools
+
+    import transformer_tpu.obs.events as evmod
+    from transformer_tpu.analysis import schedules as S
+    from transformer_tpu.obs.events import EventLog
+
+    class UnlockedLog(EventLog):
+        def emit(self, kind, **fields):  # no lock, split write
+            import json as _json
+            line = _json.dumps({"kind": kind, **fields})
+            self._file.write(line)
+            self._file.write("\n")
+
+    scen = S.Scenario(
+        name="eventlog_canary",
+        setup=functools.partial(S._scenario_eventlog, log_factory=UnlockedLog),
+        modules=lambda: [evmod],
+        instrument=lambda: [evmod.__file__, __file__],
+        max_schedules=64,
+    )
+    result = S.explore(scen)
+    assert any(v.kind == "invariant" for v in result.violations), [
+        v.to_dict() for v in result.violations
+    ]

@@ -247,6 +247,57 @@ class TestLengthBuckets:
                 if i == 1:
                     break  # worker must not block forever on q.put
 
+    def test_prefetch_fallback_joins_worker_on_early_exit(self):
+        """Closing the fallback iterator mid-epoch must JOIN the producer
+        thread (draining its in-flight device_put), not merely signal it:
+        a daemon thread outliving the iterator pins device buffers for the
+        rest of the process."""
+        import threading
+
+        from transformer_tpu.data.pipeline import _threaded_device_prefetch
+
+        src = [
+            (np.full((2,), i, np.int32), np.full((2,), i, np.int32))
+            for i in range(8)
+        ]
+        gen = _threaded_device_prefetch(iter(src), depth=1)
+        first = next(gen)
+        np.testing.assert_array_equal(np.asarray(first[0]), src[0][0])
+        gen.close()  # early exit: break/exception/abandonment all end here
+        assert not any(
+            t.name == "pipeline-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        ), "producer thread outlived the closed iterator"
+
+    def test_prefetch_fallback_joins_worker_on_consumer_exception(self):
+        """The same join guarantee when the CONSUMER dies mid-stream (the
+        exception unwinds through the generator's finally)."""
+        import threading
+
+        from transformer_tpu.data.pipeline import _threaded_device_prefetch
+
+        src = [
+            (np.full((2,), i, np.int32), np.full((2,), i, np.int32))
+            for i in range(8)
+        ]
+
+        def consume():
+            for i, _ in enumerate(_threaded_device_prefetch(iter(src), depth=1)):
+                if i == 1:
+                    raise RuntimeError("consumer died")
+
+        with pytest.raises(RuntimeError, match="consumer died"):
+            consume()
+        # The traceback can keep the consumer frame (and so the generator)
+        # alive past the raise; collect so the generator's finally has run.
+        import gc
+
+        gc.collect()
+        assert not any(
+            t.name == "pipeline-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        ), "producer thread survived the consumer's exception"
+
     def test_overlong_examples_rejected_not_clamped(self):
         """A largest bucket narrower than the data must fail loudly — silent
         clamping would truncate sentences (and their EOS) mid-stream."""

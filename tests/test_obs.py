@@ -141,6 +141,49 @@ def test_prometheus_exposition_format():
 # event log
 
 
+def test_event_log_concurrent_writers_parse_back(tmp_path):
+    """The EventLog threading contract: N real threads hammering emit()
+    produce a log where EVERY line parses back as one JSON event — no torn
+    lines, no lost events. (The deterministic-schedule twin of this test
+    lives in analysis/schedules.py eventlog_writers; the revert-the-lock
+    canary in test_analysis.py shows the explorer catching the torn case.)"""
+    import threading
+
+    from transformer_tpu.obs.events import EventLog, read_events
+
+    path = str(tmp_path / "concurrent.jsonl")
+    log = EventLog(path)
+    writers, per = 8, 100
+    start = threading.Barrier(writers)
+
+    def hammer(wid):
+        start.wait()
+        for i in range(per):
+            log.emit("obs.test", writer=wid, seq=i)
+
+    threads = [
+        threading.Thread(target=hammer, args=(w,)) for w in range(writers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    raw = [ln for ln in open(path).read().splitlines() if ln]
+    assert len(raw) == writers * per
+    events = []
+    for line in raw:
+        events.append(json.loads(line))  # a torn line dies right here
+    assert len(read_events(path, "obs.test")) == writers * per
+    # every (writer, seq) pair exactly once, in per-writer order
+    by_writer = {}
+    for ev in events:
+        by_writer.setdefault(ev["writer"], []).append(ev["seq"])
+    assert set(by_writer) == set(range(writers))
+    for seqs in by_writer.values():
+        assert seqs == list(range(per))
+
+
 def test_event_log_round_trip(tmp_path):
     path = str(tmp_path / "events.jsonl")
     log = EventLog(path)

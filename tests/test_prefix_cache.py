@@ -508,3 +508,61 @@ def test_fast_contract_matrix_covers_prefix_restore():
     assert all(
         r.ok for r in results if r.contract == "prefix_restore_parity"
     )
+
+
+def test_prefix_cache_real_thread_hammer():
+    """The PrefixCache threading contract under REAL threads: two workers
+    hammer match/insert/release against a 3-block budget (constant LRU
+    eviction). After the dust settles every refcount is zero and the byte
+    accounting re-derives exactly from the reachable trie — the same
+    invariants the deterministic explorer checks interleaving-by-
+    interleaving in analysis/schedules.py prefix_cache_contention."""
+    import threading
+
+    pc = PrefixCache(LM, block_tokens=2, budget_mb=1)
+    blk = np.zeros((1, 2, 2, 2), np.float32)
+    pc.budget_bytes = 3 * 2 * blk.nbytes  # 3 blocks: force eviction churn
+
+    def read_block(start):
+        return [{"k": blk.copy(), "v": blk.copy()}]
+
+    errors = []
+    start = threading.Barrier(2)
+
+    def hammer(prompts):
+        try:
+            start.wait()
+            for _ in range(20):
+                for ids in prompts:
+                    hit = pc.match(ids[:-1])
+                    hit.stacked(16)
+                    pc.insert(ids, (len(ids) // 2) * 2, read_block)
+                    with pc._lock:
+                        for n in hit._nodes:
+                            assert n.parent is not None and (
+                                n.parent.children.get(n.edge) is n
+                            ), "pinned block evicted while referenced"
+                    hit.release()
+        except Exception as e:  # noqa: BLE001 — collected and re-raised below
+            errors.append(e)
+
+    a = threading.Thread(
+        target=hammer, args=([[1, 2, 3, 4, 5], [1, 2, 7, 8, 9]],)
+    )
+    b = threading.Thread(
+        target=hammer, args=([[1, 2, 3, 4, 11], [13, 14, 15, 16, 17]],)
+    )
+    a.start(); b.start(); a.join(); b.join()
+    assert not errors, errors
+    # refcounts all returned to zero; byte/block accounting exact
+    total, blocks = 0, 0
+    stack = [pc._root]
+    while stack:
+        node = stack.pop()
+        stack.extend(node.children.values())
+        assert node.refs == 0, f"leaked refcount {node.refs}"
+        if node.blocks is not None:
+            total += node.nbytes
+            blocks += 1
+    assert total == pc.bytes_used <= pc.budget_bytes
+    assert blocks == pc.block_count()

@@ -7,6 +7,14 @@ Three passes, one CLI (``python -m transformer_tpu.analysis``):
   closure state, stale ``static_argnames``, donated-buffer reuse, broad
   exception swallowing in library modules. Inline ``# tpa: disable=`` and a
   checked-in baseline (``analysis/baseline.json``) handle grandfathering.
+- :mod:`.concurrency` — concurrency rules (TPA101–TPA105) over the host
+  threading surface: thread-root inference, shared-state guard discipline,
+  lock-order cycles, non-atomic RMW, blocking-under-lock. Same suppression
+  workflow, separate baseline (``analysis/concurrency_baseline.json``).
+- :mod:`.schedules` — the dynamic counterpart: a deterministic cooperative
+  scheduler that explores thread interleavings over canned serving-tier
+  scenarios (prefix-cache contention, registry scrape, prefetch shutdown,
+  event-log writers), asserting invariants under every explored schedule.
 - :mod:`.contracts` — abstract shape/dtype contract checks over the public
   entry points via ``jax.eval_shape``/``jax.make_jaxpr``: f32 softmax,
   prefill/step cache-layout parity across all cache variants, mask
@@ -20,6 +28,10 @@ Everything here is import-light: importing the package costs nothing until a
 pass actually runs (the lint rules never import the modules they analyze).
 """
 
+from transformer_tpu.analysis.concurrency import (
+    CONCURRENCY_RULES,
+    run_concurrency,
+)
 from transformer_tpu.analysis.contracts import ContractResult, run_contracts
 from transformer_tpu.analysis.retrace import RetraceSentinel, leak_checking
 from transformer_tpu.analysis.rules import (
@@ -28,12 +40,22 @@ from transformer_tpu.analysis.rules import (
     RulesReport,
     run_rules,
 )
+from transformer_tpu.analysis.schedules import (
+    ScenarioResult,
+    explore,
+    run_scenarios,
+)
 
 __all__ = [
     "RULES",
+    "CONCURRENCY_RULES",
     "Finding",
     "RulesReport",
     "run_rules",
+    "run_concurrency",
+    "ScenarioResult",
+    "explore",
+    "run_scenarios",
     "ContractResult",
     "run_contracts",
     "RetraceSentinel",

@@ -4,6 +4,13 @@ Subcommands (all CPU-safe; exit code 0 = clean, 1 = findings/violations):
 
 - ``rules [--paths P ...] [--baseline FILE] [--update-baseline]`` — AST lint
   rules TPA001–TPA006 over the package (or explicit paths).
+- ``concurrency [--paths P ...] [--baseline FILE] [--update-baseline]`` —
+  concurrency rules TPA101–TPA105 (thread-root inference, shared-state
+  guards, lock-order cycles, blocking-under-lock) over the same surface.
+- ``schedules [--max-schedules N] [--seed S] [--scenario NAME ...]`` — the
+  deterministic interleaving checker: cooperatively explores thread
+  schedules over canned serving-tier scenarios, asserting their invariants
+  under every explored interleaving.
 - ``contracts [--matrix fast|full]`` — abstract shape/dtype contract checks
   via ``jax.eval_shape``/``jax.make_jaxpr`` (no device execution).
 - ``retrace [--steps N]`` — compile-count sentinel over the steady-state
@@ -24,19 +31,17 @@ def _emit(payload: dict, text: str, fmt: str) -> None:
     print(json.dumps(payload, indent=2, sort_keys=True) if fmt == "json" else text)
 
 
-def _cmd_rules(args: argparse.Namespace) -> int:
-    from transformer_tpu.analysis.rules import (
-        default_baseline_path,
-        run_rules,
-        write_baseline,
-    )
+def _lint_command(args: argparse.Namespace, run_fn, default_baseline_fn) -> int:
+    """Shared driver for the two lint families (rules / concurrency):
+    baseline resolution, --update-baseline, report emission, exit code."""
+    from transformer_tpu.analysis.rules import write_baseline
 
     baseline = args.baseline
     if baseline is None and not args.paths:
-        baseline = default_baseline_path()
-    report = run_rules(paths=args.paths or None, baseline_path=baseline)
+        baseline = default_baseline_fn()
+    report = run_fn(paths=args.paths or None, baseline_path=baseline)
     if args.update_baseline:
-        path = baseline or default_baseline_path()
+        path = baseline or default_baseline_fn()
         write_baseline(report, path)
         print(
             f"baselined {len(report.findings) + len(report.baselined)} "
@@ -50,6 +55,50 @@ def _cmd_rules(args: argparse.Namespace) -> int:
     )
     _emit(report.to_dict(), "\n".join(lines), args.format)
     return 1 if report.findings else 0
+
+
+def _cmd_rules(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.rules import default_baseline_path, run_rules
+
+    return _lint_command(args, run_rules, default_baseline_path)
+
+
+def _cmd_concurrency(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.concurrency import (
+        default_concurrency_baseline_path,
+        run_concurrency,
+    )
+
+    return _lint_command(args, run_concurrency, default_concurrency_baseline_path)
+
+
+def _cmd_schedules(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.schedules import run_scenarios
+
+    results = run_scenarios(
+        names=args.scenario or None,
+        max_schedules=args.max_schedules,
+        seed=args.seed,
+    )
+    ok = all(not r.violations and not r.deadlocks for r in results)
+    total = sum(r.schedules for r in results)
+    lines = []
+    for r in results:
+        status = "PASS" if not r.violations and not r.deadlocks else "FAIL"
+        lines.append(
+            f"{status} {r.name}: {r.schedules} schedule(s) explored, "
+            f"{len(r.violations)} violation(s), {r.deadlocks} deadlock(s)"
+        )
+        for v in r.violations[:5]:
+            lines.append(f"  - {v.kind}: {v.detail}")
+    lines.append(f"{total} interleaving(s) explored across {len(results)} scenario(s)")
+    payload = {
+        "ok": ok,
+        "total_schedules": total,
+        "scenarios": [r.to_dict() for r in results],
+    }
+    _emit(payload, "\n".join(lines), args.format)
+    return 0 if ok else 1
 
 
 def _cmd_contracts(args: argparse.Namespace) -> int:
@@ -122,6 +171,39 @@ def main(argv: list[str] | None = None) -> int:
         help="grandfather every current finding into the baseline file",
     )
 
+    p_conc = sub.add_parser(
+        "concurrency", help="concurrency lint rules (TPA101-TPA105)"
+    )
+    p_conc.add_argument(
+        "--paths", nargs="*", default=None,
+        help="files/dirs to analyze (default: the transformer_tpu package)",
+    )
+    p_conc.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: analysis/concurrency_baseline.json "
+        "for package runs)",
+    )
+    p_conc.add_argument(
+        "--update-baseline", action="store_true",
+        help="grandfather every current finding into the baseline file",
+    )
+
+    p_sched = sub.add_parser(
+        "schedules", help="deterministic interleaving checker (canned scenarios)"
+    )
+    p_sched.add_argument(
+        "--scenario", nargs="*", default=None,
+        help="scenario names to run (default: all canned scenarios)",
+    )
+    p_sched.add_argument(
+        "--max-schedules", type=int, default=64,
+        help="bounded-exhaustive schedule cap per scenario (default 64)",
+    )
+    p_sched.add_argument(
+        "--seed", type=int, default=0,
+        help="seed for random-schedule mode (scenarios with > 2 threads)",
+    )
+
     p_contracts = sub.add_parser(
         "contracts", help="abstract shape/dtype contract checks (eval_shape)"
     )
@@ -138,16 +220,20 @@ def main(argv: list[str] | None = None) -> int:
         help="steady-state iterations after warmup (default 3)",
     )
 
-    for p in (p_rules, p_contracts, p_retrace):
+    for p in (p_rules, p_conc, p_sched, p_contracts, p_retrace):
         p.add_argument(
             "--format", choices=("text", "json"), default="text",
             help="output format (json is diff-able across rounds)",
         )
 
     args = parser.parse_args(argv)
-    return {"rules": _cmd_rules, "contracts": _cmd_contracts, "retrace": _cmd_retrace}[
-        args.cmd
-    ](args)
+    return {
+        "rules": _cmd_rules,
+        "concurrency": _cmd_concurrency,
+        "schedules": _cmd_schedules,
+        "contracts": _cmd_contracts,
+        "retrace": _cmd_retrace,
+    }[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,975 @@
+"""Concurrency static analysis (TPA101–TPA105) for the serving tier.
+
+The repo's host side already runs threads in four places (the obs scrape
+thread, the serve CLI's stdin reader, the prefetch double-buffer, event-log
+writers), and the next ROADMAP tier — multi-replica router, disaggregated
+prefill/decode, hot checkpoint swap — multiplies the mutable state those
+threads share (``PrefixCache`` refcounts, slot pools, metric registries).
+Code review is the only thing guarding lock discipline today; this module
+gives it the same machine-checked safety net TPA001–006 gave the compile
+path.
+
+Rule catalogue (docs/ANALYSIS.md has the long-form version):
+
+- **TPA101** — unguarded access to shared state: a write (or mutating call)
+  to state reachable from more than one thread root made outside any lock
+  region, or a read outside a lock of state that IS lock-guarded elsewhere.
+- **TPA102** — inconsistent guard choice: the same shared state accessed
+  under two different locks with no lock common to all guarded accesses
+  (two threads can then hold "the" lock simultaneously).
+- **TPA103** — lock-order cycle: nested acquisitions establish a partial
+  order between locks; a cycle in that order is a deadlock waiting for the
+  right interleaving.
+- **TPA104** — non-atomic read-modify-write on shared state outside a lock
+  (``self.refs += 1``, ``self.nbytes = self.nbytes - n``): two threads can
+  both read the old value and one increment is lost.
+- **TPA105** — a blocking call made while holding a lock: jitted dispatch,
+  ``jax.device_put``/``device_get``, file ``open``, ``queue.get/put``,
+  ``thread.join``, ``time.sleep``, ``subprocess.*`` — every other thread
+  that wants the lock now waits on the device/disk/peer too.
+
+**Thread roots** are inferred from the AST: functions (module-level or
+nested) passed as ``threading.Thread(target=...)``, bound methods passed
+the same way (``target=self.loop``), and ``do_*`` methods of
+``*RequestHandler`` subclasses (each request runs on a server thread).
+**Shared state** is then the module-global / ``self``-attribute / closure
+state reachable both from a thread root and from code outside it.
+
+Deliberately conservative, like TPA001–006: aliasing is not tracked (a
+local that points into a shared structure is invisible), parameters are
+not followed across calls, and initialization writes that happen before
+the thread starts (``__init__`` bodies; statements above the first
+``Thread(...)`` in a closure scope) are exempt — they happen-before the
+race. False negatives are acceptable; false positives on the shipped tree
+are rule bugs. Suppress decisions inline with ``# tpa: disable=TPA10x —
+reason`` and grandfather the rest in ``analysis/concurrency_baseline.json``
+(same fingerprint workflow as the TPA001–006 baseline).
+
+The dynamic counterpart — a deterministic interleaving explorer that RUNS
+the interesting schedules instead of approximating them — lives in
+:mod:`transformer_tpu.analysis.schedules`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable
+
+from transformer_tpu.analysis.rules import (
+    Finding,
+    RulesReport,
+    _dotted,
+    _iter_py_files,
+    _package_root,
+    _SUPPRESS_RE,
+    load_baseline,
+)
+
+CONCURRENCY_RULES: dict[str, str] = {
+    "TPA101": "unguarded access to state shared between thread roots",
+    "TPA102": "shared state guarded by two different locks",
+    "TPA103": "lock-order cycle across nested acquisitions",
+    "TPA104": "non-atomic read-modify-write on shared state outside a lock",
+    "TPA105": "blocking call made while holding a lock",
+}
+
+# Constructors whose results are lock objects (guard a `with` region).
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+})
+# Constructors whose results are internally synchronized (or immutable
+# handshake primitives): accessing them from several threads is their job.
+_SYNC_CTORS = _LOCK_CTORS | frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "threading.local",
+    "collections.deque",  # append/popleft are atomic under the GIL
+})
+_THREAD_CTORS = frozenset({"threading.Thread", "Thread"})
+_QUEUE_CTORS = frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+})
+
+# Container/object methods that mutate their receiver.
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault", "appendleft", "popleft",
+    "sort", "reverse",
+})
+
+# Calls that block the calling thread (flagged under a held lock). Dotted
+# names match exactly; bare final attributes match the listed method names
+# only when the receiver is a known queue/thread object.
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep", "open", "os.replace", "os.rename",
+    "jax.device_put", "jax.device_get", "jax.block_until_ready",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "socket.create_connection",
+})
+_BLOCKING_QUEUE_METHODS = frozenset({"get", "put", "join"})
+_BLOCKING_ANY_RECEIVER = frozenset({"block_until_ready"})
+
+_JIT_DECOS = frozenset({"jax.jit", "jit", "pjit", "jax.pjit"})
+
+
+# --------------------------------------------------------------------------
+# access bookkeeping
+
+
+@dataclasses.dataclass
+class _Access:
+    state: str                # normalized state id ("self.x", "name")
+    kind: str                 # "read" | "write" | "rmw" | "mutate"
+    node: ast.AST
+    symbol: str               # enclosing function, for reporting
+    held: frozenset[str]      # lock names held at the access
+
+
+def _call_name(node: ast.Call) -> str | None:
+    return _dotted(node.func)
+
+
+def _is_ctor(value: ast.AST, ctors: frozenset[str]) -> bool:
+    return isinstance(value, ast.Call) and _call_name(value) in ctors
+
+
+def _bound_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Names bound inside ``fn`` (params, assignments, imports, for/with
+    targets, nested defs) — used to separate closure reads from locals."""
+    a = fn.args
+    out = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(node, ast.For):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+            for sub in ast.walk(node.optional_vars):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".", 1)[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node is not fn:
+                out.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+class _AccessCollector:
+    """Walk one function body in statement order, tracking the held-lock
+    stack (``with <lock>:`` regions plus linear ``.acquire()``/``.release()``
+    pairs) and recording every access to the state ids in ``states``.
+
+    ``resolve(expr) -> state id | None`` maps an expression to a state id
+    (class scope: ``self.X``; closure/module scope: bare names).
+    """
+
+    def __init__(
+        self,
+        module: "_ConcModule",
+        symbol: str,
+        states: set[str],
+        resolve,
+        skip_defs: set[int] | None = None,
+        track_locks: bool = False,
+    ):
+        self.module = module
+        self.symbol = symbol
+        self.states = states
+        self.resolve = resolve
+        self.skip_defs = skip_defs or set()
+        self.track_locks = track_locks
+        self.accesses: list[_Access] = []
+        self.blocking: list[tuple[ast.Call, str, frozenset[str]]] = []
+        self.order_edges: list[tuple[str, str, ast.AST]] = []
+
+    # -- lock resolution
+    def _lock_name(self, expr: ast.AST) -> str | None:
+        chain = _dotted(expr)
+        if chain is None:
+            return None
+        leaf = chain.rsplit(".", 1)[-1]
+        return leaf if leaf in self.module.lock_names else None
+
+    # -- the walk
+    def walk(self, body: Iterable[ast.stmt], held: list[str]) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: list[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if id(stmt) in self.skip_defs:
+                return
+            # Nested defs (closures run later, possibly on another thread's
+            # schedule — but from THIS scope's perspective they see the same
+            # state): scan with the current lock stack cleared; a closure
+            # body does not inherit the definer's held locks at call time.
+            self.walk(stmt.body, [])
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                self._stmt(sub, held)
+            return
+        if isinstance(stmt, ast.With):
+            entered: list[str] = []
+            for item in stmt.items:
+                self._exprs(item.context_expr, held)
+                lock = self._lock_name(item.context_expr)
+                if lock is not None:
+                    if self.track_locks:
+                        for outer in held:
+                            if outer != lock:
+                                self.order_edges.append((outer, lock, stmt))
+                    entered.append(lock)
+            self.walk(stmt.body, held + entered)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._exprs(stmt.test, held)
+            self.walk(stmt.body, list(held))
+            self.walk(stmt.orelse, list(held))
+            return
+        if isinstance(stmt, ast.For):
+            self._exprs(stmt.iter, held)
+            # Iterating shared state reads it.
+            self._record(stmt.iter, "read", held)
+            self.walk(stmt.body, list(held))
+            self.walk(stmt.orelse, list(held))
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body, list(held))
+            for h in stmt.handlers:
+                self.walk(h.body, list(held))
+            self.walk(stmt.orelse, list(held))
+            self.walk(stmt.finalbody, list(held))
+            return
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            # lock.acquire() / lock.release() as bare statements toggle the
+            # linear lock stack for the REST of this block.
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute):
+                lock = self._lock_name(call.func.value)
+                if lock is not None and call.func.attr == "acquire":
+                    if self.track_locks:
+                        for outer in held:
+                            if outer != lock:
+                                self.order_edges.append((outer, lock, stmt))
+                    self._exprs(call, held)
+                    held.append(lock)
+                    return
+                if lock is not None and call.func.attr == "release":
+                    self._exprs(call, held)
+                    if lock in held:
+                        held.remove(lock)
+                    return
+            self._exprs(stmt.value, held)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._exprs(stmt.value, held)
+            rmw = self._value_reads(stmt.value, stmt.targets)
+            for t in stmt.targets:
+                self._target(t, held, rmw)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._exprs(stmt.value, held)
+            self._target(stmt.target, held, rmw=False)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._exprs(stmt.value, held)
+            sid = self.resolve(stmt.target)
+            if sid in self.states:
+                self.accesses.append(
+                    _Access(sid, "rmw", stmt, self.symbol, frozenset(held))
+                )
+            else:
+                self._target(stmt.target, held, rmw=False)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                sid = self.resolve(base)
+                if sid in self.states:
+                    self.accesses.append(
+                        _Access(sid, "mutate", stmt, self.symbol, frozenset(held))
+                    )
+            return
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            for child in ast.iter_child_nodes(stmt):
+                self._exprs(child, held)
+            return
+        # Anything else: scan its expressions generically.
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._exprs(child, held)
+
+    def _value_reads(self, value: ast.AST, targets: list[ast.AST]) -> bool:
+        """``x = x + 1`` is the same lost-update RMW as ``x += 1``."""
+        target_ids = {self.resolve(t) for t in targets} - {None}
+        if not target_ids:
+            return False
+        for node in ast.walk(value):
+            if self.resolve(node) in target_ids:
+                return True
+        return False
+
+    def _target(self, target: ast.AST, held: list[str], rmw: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._target(elt, held, rmw)
+            return
+        if isinstance(target, ast.Starred):
+            self._target(target.value, held, rmw)
+            return
+        if isinstance(target, ast.Subscript):
+            sid = self.resolve(target.value)
+            if sid in self.states:
+                self.accesses.append(
+                    _Access(sid, "mutate", target, self.symbol, frozenset(held))
+                )
+            self._exprs(target.slice, held)
+            return
+        sid = self.resolve(target)
+        if sid in self.states:
+            self.accesses.append(
+                _Access(
+                    sid, "rmw" if rmw else "write", target, self.symbol,
+                    frozenset(held),
+                )
+            )
+
+    def _record(self, expr: ast.AST, kind: str, held: list[str]) -> None:
+        sid = self.resolve(expr)
+        if sid in self.states:
+            self.accesses.append(
+                _Access(sid, kind, expr, self.symbol, frozenset(held))
+            )
+
+    def _exprs(self, root: ast.AST, held: list[str]) -> None:
+        """Scan an expression tree for state reads, mutating calls, and
+        blocking calls under a held lock."""
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+            elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                sid = self.resolve(node)
+                if sid in self.states and not self._is_mutator_receiver(node):
+                    self.accesses.append(
+                        _Access(sid, "read", node, self.symbol, frozenset(held))
+                    )
+
+    def _is_mutator_receiver(self, node: ast.AST) -> bool:
+        # The receiver load inside `x.append(...)` is reported as the
+        # mutate access by _call, not double-counted as a read here.
+        parent = getattr(node, "_tpa_parent", None)
+        return (
+            isinstance(parent, ast.Attribute)
+            and parent.attr in _MUTATORS
+        )
+
+    def _call(self, node: ast.Call, held: list[str]) -> None:
+        fname = _call_name(node)
+        # mutating method on shared state: x.append(...), self.stats.update()
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            sid = self.resolve(node.func.value)
+            if sid in self.states:
+                self.accesses.append(
+                    _Access(sid, "mutate", node, self.symbol, frozenset(held))
+                )
+        if not held or not self.track_locks:
+            return
+        # blocking call while holding a lock?
+        reason = None
+        if fname in _BLOCKING_DOTTED:
+            reason = f"`{fname}` blocks"
+        elif fname in self.module.jitted_names:
+            reason = f"`{fname}` dispatches a jitted computation"
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_ANY_RECEIVER:
+                reason = f"`.{attr}()` blocks on device completion"
+            elif attr in _BLOCKING_QUEUE_METHODS:
+                recv = _dotted(node.func.value)
+                leaf = recv.rsplit(".", 1)[-1] if recv else None
+                if leaf in self.module.queue_names:
+                    reason = f"`{recv}.{attr}()` can block on the queue"
+                elif leaf in self.module.thread_obj_names and attr == "join":
+                    reason = f"`{recv}.join()` blocks until the thread exits"
+        if reason is not None:
+            self.blocking.append((node, reason, frozenset(held)))
+
+
+# --------------------------------------------------------------------------
+# per-module analysis
+
+
+class _ConcModule:
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._annotate_parents()
+        self.lock_names = self._collect_lock_names()
+        self.queue_names = self._collect_ctor_names(_QUEUE_CTORS)
+        self.thread_obj_names = self._collect_ctor_names(_THREAD_CTORS)
+        self.sync_names = self._collect_ctor_names(_SYNC_CTORS)
+        self.jitted_names = self._collect_jitted_names()
+        self.findings: list[Finding] = []
+        self.order_edges: list[tuple[str, str, ast.AST, str]] = []
+
+    def _annotate_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._tpa_parent = node  # type: ignore[attr-defined]
+
+    # -- name collections --------------------------------------------------
+
+    def _collect_lock_names(self) -> set[str]:
+        """Bare attribute/global names assigned a Lock/RLock/Condition
+        anywhere in the module. Identity is the leaf name — `self._lock`
+        in one class and `sched._lock` seen from another resolve to the
+        same guard, which is how the code actually uses them."""
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and _is_ctor(node.value, _LOCK_CTORS):
+                for t in node.targets:
+                    chain = _dotted(t)
+                    if chain:
+                        out.add(chain.rsplit(".", 1)[-1])
+        return out
+
+    def _collect_ctor_names(self, ctors: frozenset[str]) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and _is_ctor(node.value, ctors):
+                for t in node.targets:
+                    chain = _dotted(t)
+                    if chain:
+                        out.add(chain.rsplit(".", 1)[-1])
+        return out
+
+    def _collect_jitted_names(self) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    name = _dotted(d)
+                    if name in _JIT_DECOS:
+                        out.add(node.name)
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and name in ("partial", "functools.partial")
+                        and dec.args
+                        and _dotted(dec.args[0]) in _JIT_DECOS
+                    ):
+                        out.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _dotted(node.value.func) in _JIT_DECOS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    # -- reporting helpers --------------------------------------------------
+
+    def finding(self, code: str, node: ast.AST, symbol: str, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        snippet = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        return Finding(
+            code=code, path=self.rel, line=line, symbol=symbol,
+            message=message, snippet=snippet,
+        )
+
+    def suppressed(self, f: Finding) -> bool:
+        if not 0 < f.line <= len(self.lines):
+            return False
+        m = _SUPPRESS_RE.search(self.lines[f.line - 1])
+        if not m:
+            return False
+        codes = m.group(1)
+        if codes is None:
+            return True
+        return f.code in {c.strip() for c in codes.split(",")}
+
+    # -- thread roots -------------------------------------------------------
+
+    @staticmethod
+    def _thread_targets(scope: ast.AST) -> list[ast.AST]:
+        """Expressions passed as ``target=`` to ``threading.Thread(...)``
+        within ``scope``."""
+        out = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and _call_name(node) in _THREAD_CTORS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        out.append(kw.value)
+        return out
+
+    # -- the three scopes ---------------------------------------------------
+
+    def analyze(self) -> list[Finding]:
+        # Lock-discipline pass first (TPA103/TPA105 need lock regions, not
+        # shared-state discovery): every outermost function exactly once —
+        # _AccessCollector recurses into nested defs itself.
+        self._lock_pass()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._analyze_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_closure_scope(node)
+        self._analyze_module_scope()
+        self._lock_order_findings()
+        return self.findings
+
+    def _lock_pass(self) -> None:
+        if not self.lock_names:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            parent = getattr(node, "_tpa_parent", None)
+            enclosing = None
+            while parent is not None:
+                if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing = parent
+                    break
+                parent = getattr(parent, "_tpa_parent", None)
+            if enclosing is not None:
+                continue  # nested def: walked by its outermost ancestor
+            symbol = node.name
+            p = getattr(node, "_tpa_parent", None)
+            if isinstance(p, ast.ClassDef):
+                symbol = f"{p.name}.{node.name}"
+            col = _AccessCollector(
+                self, symbol, set(), lambda e: None, track_locks=True
+            )
+            col.walk(node.body, [])
+            for call, reason, held in col.blocking:
+                self._blocking_finding(call, reason, held, symbol)
+            for a, b, edge_node in col.order_edges:
+                self.order_edges.append((a, b, edge_node, symbol))
+
+    # .. class scope: self-attribute state
+
+    def _analyze_class(self, cls: ast.ClassDef) -> None:
+        methods = {
+            s.name: s
+            for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not methods:
+            return
+        is_handler = any(
+            (_dotted(b) or "").endswith("RequestHandler") for b in cls.bases
+        )
+        roots: set[str] = set()
+        if is_handler:
+            roots.update(n for n in methods if n.startswith("do_"))
+        for target in self._thread_targets(cls):
+            chain = _dotted(target)
+            if chain is None:
+                continue
+            leaf = chain.rsplit(".", 1)[-1]
+            if (chain.startswith("self.") or chain.startswith(cls.name + ".")) \
+                    and leaf in methods:
+                roots.add(leaf)
+        if not roots:
+            return
+
+        # Intra-class call graph: reachability from the thread roots.
+        calls: dict[str, set[str]] = {}
+        for name, fn in methods.items():
+            callees = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    chain = _dotted(node.func)
+                    if chain and chain.startswith("self."):
+                        leaf = chain.split(".", 1)[1]
+                        if leaf in methods:
+                            callees.add(leaf)
+            calls[name] = callees
+        reach = set(roots)
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            for callee in calls.get(m, ()):
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+
+        def resolve(expr: ast.AST):
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+            ):
+                return f"self.{expr.attr}"
+            return None
+
+        # All self-attrs, to find which are accessed on both sides.
+        per_method: dict[str, set[str]] = {}
+        for name, fn in methods.items():
+            attrs = set()
+            for node in ast.walk(fn):
+                sid = resolve(node)
+                if sid is not None:
+                    leaf = sid.split(".", 1)[1]
+                    if leaf not in self.sync_names:
+                        attrs.add(sid)
+            per_method[name] = attrs
+        root_side = set().union(*(per_method[m] for m in reach)) if reach else set()
+        other_methods = [
+            m for m in methods
+            if m not in reach and m not in ("__init__", "__post_init__", "__del__")
+        ]
+        other_side = (
+            set().union(*(per_method[m] for m in other_methods))
+            if other_methods else set()
+        )
+        shared = root_side & other_side
+        if not shared:
+            return
+        symbol_prefix = cls.name
+        accesses: list[_Access] = []
+        for name, fn in methods.items():
+            if name in ("__init__", "__post_init__"):
+                continue  # happens-before thread start
+            col = _AccessCollector(
+                self, f"{symbol_prefix}.{name}", shared, resolve
+            )
+            col.walk(fn.body, [])
+            accesses.extend(col.accesses)
+        self._shared_state_findings(accesses)
+
+    # .. closure scope: Thread(target=<nested def>) sharing enclosing locals
+
+    def _analyze_closure_scope(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        nested = {
+            s.name: s
+            for s in fn.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not nested:
+            return
+        roots: list[ast.FunctionDef | ast.AsyncFunctionDef] = []
+        first_thread_line = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _call_name(node) in _THREAD_CTORS:
+                if first_thread_line is None or node.lineno < first_thread_line:
+                    first_thread_line = node.lineno
+                for kw in node.keywords:
+                    if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                        w = nested.get(kw.value.id)
+                        if w is not None and w not in roots:
+                            roots.append(w)
+        if not roots:
+            return
+        fn_bound = _bound_names(fn)
+        import_bound: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    import_bound.add((alias.asname or alias.name).split(".", 1)[0])
+
+        def resolve(expr: ast.AST):
+            if isinstance(expr, ast.Name):
+                return expr.id
+            return None
+
+        shared_all: set[str] = set()
+        root_ids = {id(w) for w in roots}
+        per_root_free: dict[int, set[str]] = {}
+        for w in roots:
+            w_bound = _bound_names(w)
+            free = set()
+            for node in ast.walk(w):
+                if isinstance(node, ast.Name) and node.id in fn_bound \
+                        and node.id not in w_bound:
+                    free.add(node.id)
+            free -= import_bound
+            free -= {n.name for n in nested.values() if hasattr(n, "name")}
+            free -= self.sync_names
+            per_root_free[id(w)] = free
+        # outside accesses: names used in fn AFTER the first Thread(...)
+        # construction, outside the root defs (statements before it
+        # happen-before the thread starts).
+        outside: set[str] = set()
+        root_nodes = {id(n) for w in roots for n in ast.walk(w)}
+        for node in ast.walk(fn):
+            if id(node) in root_nodes or not isinstance(node, ast.Name):
+                continue
+            if first_thread_line is not None and node.lineno <= first_thread_line:
+                continue
+            outside.add(node.id)
+        for w in roots:
+            others = outside | set().union(
+                *(f for i, f in per_root_free.items() if i != id(w)), set()
+            )
+            shared_all |= per_root_free[id(w)] & others
+        shared_all -= self.sync_names
+        if not shared_all:
+            return
+        accesses: list[_Access] = []
+        # Collect accesses inside each root (full body) ...
+        for w in roots:
+            col = _AccessCollector(
+                self, f"{fn.name}.{w.name}", shared_all, resolve
+            )
+            col.walk(w.body, [])
+            accesses.extend(col.accesses)
+        # ... and in the enclosing body after thread start, skipping roots.
+        col = _AccessCollector(
+            self, fn.name, shared_all, resolve, skip_defs=root_ids
+        )
+        col.walk(fn.body, [])
+        accesses.extend(
+            a for a in col.accesses
+            if first_thread_line is None
+            or getattr(a.node, "lineno", 0) > first_thread_line
+        )
+        self._shared_state_findings(accesses)
+
+    # .. module scope: globals shared with module-level thread targets
+
+    def _analyze_module_scope(self) -> None:
+        top_defs = {
+            s.name: s
+            for s in self.tree.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        roots: set[str] = set()
+        for target in self._thread_targets(self.tree):
+            if isinstance(target, ast.Name) and target.id in top_defs:
+                roots.add(target.id)
+        if not roots:
+            return
+        module_globals = set()
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_globals.add(t.id)
+        module_globals -= self.sync_names
+        if not module_globals:
+            return
+
+        def resolve(expr: ast.AST):
+            if isinstance(expr, ast.Name):
+                return expr.id
+            return None
+
+        # call-graph closure over module-level defs
+        calls: dict[str, set[str]] = {}
+        for name, fn in top_defs.items():
+            callees = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    if node.func.id in top_defs:
+                        callees.add(node.func.id)
+            calls[name] = callees
+        reach = set(roots)
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            for callee in calls.get(m, ()):
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+
+        def fn_accessed(fn) -> set[str]:
+            out = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in module_globals:
+                    bound = _bound_names(fn)
+                    if node.id not in bound:
+                        out.add(node.id)
+            return out
+
+        root_side = set().union(*(fn_accessed(top_defs[m]) for m in reach))
+        other = [m for m in top_defs if m not in reach]
+        other_side = (
+            set().union(*(fn_accessed(top_defs[m]) for m in other))
+            if other else set()
+        )
+        shared = root_side & other_side
+        if not shared:
+            return
+        accesses: list[_Access] = []
+        for name, fn in top_defs.items():
+            col = _AccessCollector(self, name, shared, resolve)
+            col.walk(fn.body, [])
+            accesses.extend(col.accesses)
+        self._shared_state_findings(accesses)
+
+    # -- findings from collected accesses -----------------------------------
+
+    def _shared_state_findings(self, accesses: list[_Access]) -> None:
+        by_state: dict[str, list[_Access]] = {}
+        for a in accesses:
+            by_state.setdefault(a.state, []).append(a)
+        for state, acc in by_state.items():
+            guarded = [a for a in acc if a.held]
+            guard_locks = set().union(*(a.held for a in guarded)) if guarded else set()
+            common = (
+                frozenset.intersection(*(a.held for a in guarded))
+                if guarded else frozenset()
+            )
+            # TPA102: two different locks, none common to all guarded uses.
+            if len(guard_locks) >= 2 and not common:
+                a = guarded[0]
+                self.findings.append(
+                    self.finding(
+                        "TPA102", a.node, a.symbol,
+                        f"`{state}` is guarded by {len(guard_locks)} different "
+                        f"locks ({', '.join(sorted(guard_locks))}) — two "
+                        "threads can each hold 'the' lock; pick one guard",
+                    )
+                )
+            for a in acc:
+                if a.held:
+                    continue
+                if a.kind == "rmw":
+                    self.findings.append(
+                        self.finding(
+                            "TPA104", a.node, a.symbol,
+                            f"non-atomic read-modify-write on shared "
+                            f"`{state}` outside a lock — two threads can "
+                            "both read the old value and one update is lost",
+                        )
+                    )
+                elif a.kind in ("write", "mutate"):
+                    self.findings.append(
+                        self.finding(
+                            "TPA101", a.node, a.symbol,
+                            f"unguarded write to `{state}`, which is shared "
+                            "with a thread root — wrap it in the owning lock "
+                            "(or document the happens-before edge inline)",
+                        )
+                    )
+                elif guarded:
+                    self.findings.append(
+                        self.finding(
+                            "TPA101", a.node, a.symbol,
+                            f"unguarded read of `{state}`, which is "
+                            "lock-guarded elsewhere — a torn/stale read; "
+                            "take the same lock",
+                        )
+                    )
+
+    def _lock_order_findings(self) -> None:
+        graph: dict[str, dict[str, tuple[ast.AST, str]]] = {}
+        for a, b, node, symbol in self.order_edges:
+            graph.setdefault(a, {}).setdefault(b, (node, symbol))
+        # DFS cycle detection; each distinct cycle (as a lock set) is
+        # reported once, at the edge that closes it.
+        reported: set[frozenset[str]] = set()
+
+        def dfs(start: str, cur: str, path: list[str]) -> None:
+            for nxt in graph.get(cur, {}):
+                if nxt == start:
+                    cyc = [*path, cur]
+                    key = frozenset(cyc)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    node, symbol = graph[cur][nxt]
+                    order = " -> ".join([*cyc, start])
+                    self.findings.append(
+                        self.finding(
+                            "TPA103", node, symbol,
+                            f"lock-order cycle {order}: another thread "
+                            "acquiring in the opposite order deadlocks both "
+                            "— impose one global acquisition order",
+                        )
+                    )
+                elif nxt not in path and nxt != cur:
+                    dfs(start, nxt, [*path, cur])
+
+        for start in sorted(graph):
+            dfs(start, start, [])
+
+    def _blocking_finding(
+        self, node: ast.Call, reason: str, held: frozenset[str], symbol: str
+    ) -> None:
+        self.findings.append(
+            self.finding(
+                "TPA105", node, symbol,
+                f"{reason} while holding {', '.join(sorted(held))} — every "
+                "thread contending for the lock now waits on this call too; "
+                "move it outside the critical section",
+            )
+        )
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def default_concurrency_baseline_path() -> str:
+    return os.path.join(_package_root(), "analysis", "concurrency_baseline.json")
+
+
+def run_concurrency(
+    paths: list[str] | None = None,
+    baseline_path: str | None = None,
+) -> RulesReport:
+    """Run the TPA101–105 concurrency rules over ``paths`` (default: the
+    installed ``transformer_tpu`` package + its concurrency baseline)."""
+    if paths is None:
+        paths = [_package_root()]
+        if baseline_path is None:
+            baseline_path = default_concurrency_baseline_path()
+    baseline = load_baseline(baseline_path)
+    findings: list[Finding] = []
+    baselined: list[Finding] = []
+    n_files = 0
+    for full, rel in _iter_py_files(paths):
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            mod = _ConcModule(full, rel, source)
+        except SyntaxError as e:
+            raise SyntaxError(f"cannot analyze {full}: {e}") from e
+        n_files += 1
+        raw = mod.analyze()
+        # Nested ast.walk scopes can visit a class twice (module walk +
+        # enclosing-function walk); dedupe by (code, path, line, message).
+        seen: set[tuple] = set()
+        for f in raw:
+            key = (f.code, f.path, f.line, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            if mod.suppressed(f):
+                continue
+            if f.fingerprint in baseline:
+                baselined.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return RulesReport(findings=findings, baselined=baselined, files_checked=n_files)

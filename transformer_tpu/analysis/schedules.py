@@ -1,0 +1,956 @@
+"""Deterministic interleaving checker for the serving tier's host threads.
+
+The static rules (:mod:`.concurrency`, TPA101–105) approximate what COULD
+race; this module RUNS the schedules. A cooperative scheduler takes over
+``threading.Lock``/``Thread``/``Condition``/``Event`` and ``queue.Queue``
+inside the modules under test (their module-level ``threading``/``queue``
+names are swapped for scheduler-aware shims), serializes every thread onto
+one token, and yields at each line of instrumented package code — so a
+"schedule" is an explicit, replayable sequence of which-thread-runs-next
+decisions instead of whatever the OS felt like. Exploration is
+
+- **bounded-exhaustive** for the canned 2-thread scenarios: a DFS over the
+  decision tree with replay (run a prefix of decisions, then default to
+  INERTIA — keep running the thread that ran last — and queue every
+  untaken branch), breadth-first, so the cap is spent on low-preemption
+  schedules first: every single-context-switch schedule, then every
+  two-switch one, and so on. Most real races need only one or two
+  preemptions (the CHESS observation), which is why the revert-the-lock
+  canaries are found within a 64-schedule budget;
+- **seeded-random** beyond 2 threads (the tree is too wide): distinct
+  decision traces under a seeded RNG, deduped.
+
+Every explored schedule must terminate (a blocked-forever thread set is
+reported as a deadlock, a runaway one as non-termination) and must uphold
+the scenario's invariants — refcounts never negative, byte accounting
+exact, JSONL lines never torn, the scrape never observes a half-built
+registry. The canned scenarios cover the four places this repo already
+runs threads: ``PrefixCache`` admission/retirement vs. eviction, registry
+scrape vs. lazy metric creation, prefetch producer vs. consumer shutdown,
+and concurrent ``EventLog`` writers. ``python -m transformer_tpu.analysis
+schedules`` runs them all; ``tests/test_analysis.py`` pins ≥ 200 explored
+interleavings with zero violations, and the revert-the-lock canary proves
+the explorer actually catches the bug class the PR 3 registry lock fixed.
+
+Timeouts are modeled deterministically: a timed wait may only give up when
+no other thread can run — the schedule space stays finite and replayable,
+while liveness bugs (a producer that spins forever because its consumer
+left) still surface as non-termination.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import io
+import json
+import queue as _queue
+import random
+import sys
+import threading
+from collections import deque
+from typing import Callable, Iterable
+
+_STEP_CAP = 200_000  # driver iterations per schedule: non-termination guard
+
+
+class _SchedulerAbort(BaseException):
+    """Raised inside a controlled thread to unwind it during teardown.
+    BaseException so scenario code's ``except Exception`` cannot eat it."""
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str              # "exception" | "invariant" | "deadlock" | "nontermination"
+    detail: str
+    # Branch-point decision trace that reproduces it: exactly the indices
+    # run() consumes as a replay prefix (forced single-runnable points are
+    # NOT recorded — the prefix is indexed by multi-choice count).
+    schedule: list[int]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    schedules: int         # distinct interleavings fully explored
+    deadlocks: int
+    violations: list[Violation]
+    max_decisions: int     # longest decision trace seen (tree depth bound)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.deadlocks
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "schedules": self.schedules,
+            "deadlocks": self.deadlocks,
+            "max_decisions": self.max_decisions,
+            "violations": [v.to_dict() for v in self.violations],
+        }
+
+
+# --------------------------------------------------------------------------
+# the cooperative scheduler
+
+
+class _DetThread:
+    """One controlled thread: a real daemon thread that only runs while it
+    holds the scheduler's token."""
+
+    def __init__(self, sched: "DetScheduler", target, name, args=(), kwargs=None,
+                 daemon=None):
+        self.sched = sched
+        self.target = target
+        self.name = name
+        self.args = args
+        self.kwargs = kwargs or {}
+        self.tid = sched._register(self)
+        self.started = False
+        self.finished = False
+        self.pred: Callable[[], bool] | None = None
+        self.timeout_ok = False     # pred-wait may give up when nothing else runs
+        self.timed_out = False
+        self._sem = threading.Semaphore(0)
+        self._thread = threading.Thread(
+            target=self._bootstrap, name=f"det-{name}", daemon=True
+        )
+
+    # threading.Thread API surface the shims expose
+    def start(self) -> None:
+        if self.started:
+            raise RuntimeError(f"thread {self.name} already started")
+        self.started = True
+        self._thread.start()
+        # Give the driver a chance to interleave right after spawn, matching
+        # real threading where the child may run before start() returns.
+        self.sched.switch_point()
+
+    def is_alive(self) -> bool:
+        return self.started and not self.finished
+
+    def join(self, timeout: float | None = None) -> None:
+        if not self.started:
+            return
+        if timeout is None:
+            self.sched.block_until(lambda: self.finished)
+        else:
+            self.sched.timeout_wait(lambda: self.finished)
+
+    @property
+    def daemon(self) -> bool:  # shim compatibility
+        return True
+
+    def _bootstrap(self) -> None:
+        sys.settrace(self.sched._trace)
+        self._sem.acquire()  # wait to be scheduled the first time
+        try:
+            if self.sched._abort:
+                raise _SchedulerAbort
+            self.target(*self.args, **self.kwargs)
+        except _SchedulerAbort:
+            pass
+        except BaseException as e:  # tpa: disable=TPA006 — the whole point: ANY scenario failure is recorded as a schedule violation with its reproducing decision trace, then teardown continues
+            self.sched._record_exception(self, e)
+        finally:
+            sys.settrace(None)
+            self.finished = True  # tpa: disable=TPA101 — scheduler handoff: the driver reads `finished` only after this thread releases the control token on the next line, and controlled threads only while holding it
+            self.sched._control.release()
+
+
+class DetScheduler:
+    """Serializes controlled threads onto one token and records/replays the
+    which-thread-next decisions. One instance per explored schedule."""
+
+    def __init__(self, instrument_files: Iterable[str] = ()):
+        self._instrument = {str(f) for f in instrument_files}
+        self.threads: list[_DetThread] = []
+        self._control = threading.Semaphore(0)
+        self._current: _DetThread | None = None
+        self._last: _DetThread | None = None
+        self._abort = False
+        self.decision_log: list[tuple[int, int]] = []  # (n_options, chosen)
+        self.decisions: list[int] = []                 # chosen indices (all points)
+        self.violations: list[Violation] = []
+        self.deadlocked = False
+
+    # ---- registration -----------------------------------------------------
+
+    def _register(self, t: _DetThread) -> int:
+        self.threads.append(t)
+        return len(self.threads) - 1
+
+    def spawn(self, target, name: str, args=(), kwargs=None) -> _DetThread:
+        return _DetThread(self, target, name, args=args, kwargs=kwargs)
+
+    def find_thread(self, name: str) -> "_DetThread | None":
+        for t in self.threads:
+            if t.name == name or t.name == f"det-{name}" or name in t.name:
+                return t
+        return None
+
+    # ---- thread-side yield points ----------------------------------------
+
+    def _running(self) -> _DetThread | None:
+        cur = self._current
+        if cur is not None and cur._thread is threading.current_thread():
+            return cur
+        return None
+
+    def switch_point(self) -> None:
+        """Hand the token back to the driver; it may resume us immediately
+        or run someone else first. No-op off a controlled thread and during
+        teardown (so ``finally`` blocks unwind without scheduling)."""
+        t = self._running()
+        if t is None or self._abort:
+            return
+        self._control.release()
+        t._sem.acquire()
+        if self._abort:
+            raise _SchedulerAbort
+
+    def block_until(self, pred: Callable[[], bool]) -> None:
+        t = self._running()
+        if t is None or self._abort:
+            return
+        t.pred = pred
+        self._control.release()
+        t._sem.acquire()
+        t.pred = None
+        if self._abort:
+            raise _SchedulerAbort
+
+    def timeout_wait(self, pred: Callable[[], bool]) -> bool:
+        """Deterministic timed wait: resumed when ``pred`` holds OR when no
+        other thread can make progress (the only moment a real timeout is
+        observable without reintroducing wall-clock nondeterminism).
+        Returns whether ``pred`` held at resume."""
+        t = self._running()
+        if t is None or self._abort:
+            return pred()
+        t.pred = pred
+        t.timeout_ok = True
+        self._control.release()
+        t._sem.acquire()
+        t.pred = None
+        t.timeout_ok = False
+        if self._abort:
+            raise _SchedulerAbort
+        return pred()
+
+    def branch_trace(self) -> list[int]:
+        """The choices made at branch points so far — the exact list
+        ``run()`` accepts back as a replay ``prefix``."""
+        return [c for _, c in self.decision_log]
+
+    def _record_exception(self, t: _DetThread, e: BaseException) -> None:
+        self.violations.append(
+            Violation(
+                kind="exception",
+                detail=f"{t.name}: {type(e).__name__}: {e}",
+                schedule=self.branch_trace(),
+            )
+        )
+
+    # ---- line-granularity preemption --------------------------------------
+
+    def _trace(self, frame, event, arg):
+        if event != "call":
+            return None
+        if frame.f_code.co_filename not in self._instrument:
+            return None
+        return self._trace_line
+
+    def _trace_line(self, frame, event, arg):
+        if event == "line":
+            self.switch_point()
+        return self._trace_line
+
+    # ---- the driver -------------------------------------------------------
+
+    def run(self, prefix: list[int], rng: random.Random | None = None) -> None:
+        """Drive every started thread to completion, replaying ``prefix``
+        decisions then defaulting to the first runnable thread (or ``rng``
+        choices). Deadlock/non-termination are recorded as violations."""
+        steps = 0
+        while True:
+            live = [t for t in self.threads if t.started and not t.finished]
+            if not live:
+                break
+            steps += 1
+            if steps > _STEP_CAP:
+                self.violations.append(
+                    Violation(
+                        kind="nontermination",
+                        detail=f"schedule exceeded {_STEP_CAP} steps",
+                        schedule=self.branch_trace(),
+                    )
+                )
+                self._teardown(live)
+                return
+            runnable = [t for t in live if t.pred is None or t.pred()]
+            if not runnable:
+                timed = [t for t in live if t.pred is not None and t.timeout_ok]
+                if timed:
+                    runnable = timed  # their deterministic timeout fires now
+                else:
+                    self.deadlocked = True
+                    self.violations.append(
+                        Violation(
+                            kind="deadlock",
+                            detail="all live threads blocked: "
+                            + ", ".join(t.name for t in live),
+                            schedule=self.branch_trace(),
+                        )
+                    )
+                    self._teardown(live)
+                    return
+            if len(runnable) == 1:
+                chosen = 0
+            else:
+                i = len(self.decision_log)
+                if i < len(prefix):
+                    chosen = min(prefix[i], len(runnable) - 1)
+                elif rng is not None:
+                    chosen = rng.randrange(len(runnable))
+                else:
+                    # Inertia: keep running the last-scheduled thread, so a
+                    # frontier deviation is ONE context switch followed by
+                    # run-to-completion — the decision tree enumerates
+                    # schedules by preemption count.
+                    chosen = 0
+                    if self._last is not None and self._last in runnable:
+                        chosen = runnable.index(self._last)
+                self.decision_log.append((len(runnable), chosen))
+            self.decisions.append(chosen)
+            t = runnable[chosen]
+            self._last = t
+            self._current = t
+            t._sem.release()
+            self._control.acquire()
+            self._current = None
+
+    def _teardown(self, live: list[_DetThread]) -> None:
+        """Unwind parked threads: wake each with the abort flag set; yield
+        points become no-ops so ``finally`` blocks run to completion."""
+        self._abort = True
+        for t in live:
+            if t.finished:
+                continue
+            t._sem.release()
+            self._control.acquire()
+
+
+# --------------------------------------------------------------------------
+# scheduler-aware primitives (what the shims hand to the code under test)
+
+
+class DetLock:
+    def __init__(self, sched: DetScheduler):
+        self._sched = sched
+        self._owner: object = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t = self._sched._running()
+        if t is None:
+            # Driver-side (scenario setup) use: must be uncontended.
+            if self._owner is not None:
+                raise RuntimeError("driver acquired a held DetLock")
+            self._owner = "<driver>"
+            return True
+        self._sched.switch_point()
+        if self._owner is not None:
+            if not blocking:
+                return False
+            # A Lock is not reentrant: self-acquire blocks forever — which
+            # the driver reports as the deadlock it is.
+            self._sched.block_until(lambda: self._owner is None)
+        self._owner = t
+        return True
+
+    def release(self) -> None:
+        t = self._sched._running()
+        if t is None:
+            if self._owner != "<driver>":
+                raise RuntimeError("driver released a thread-held DetLock")
+            self._owner = None
+            return
+        if self._owner is not t:
+            raise RuntimeError("release of a DetLock the thread does not hold")
+        self._owner = None
+        self._sched.switch_point()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class DetRLock(DetLock):
+    def __init__(self, sched: DetScheduler):
+        super().__init__(sched)
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t = self._sched._running()
+        if t is not None and self._owner is t:
+            self._count += 1
+            return True
+        ok = super().acquire(blocking, timeout)
+        if ok:
+            self._count = 1
+        return ok
+
+    def release(self) -> None:
+        if self._count > 1:
+            self._count -= 1
+            return
+        self._count = 0
+        super().release()
+
+
+class DetCondition:
+    def __init__(self, sched: DetScheduler, lock: DetLock | None = None):
+        self._sched = sched
+        self._lock = lock if lock is not None else DetLock(sched)
+        self._waiters: list[list] = []  # [notified?] cells, FIFO
+
+    # context manager delegates to the lock
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        t = self._sched._running()
+        if self._lock._owner is not t:
+            raise RuntimeError("cond.wait() without the lock held")
+        cell = [False]
+        self._waiters.append(cell)
+        self._lock._owner = None  # release while waiting
+        if timeout is None:
+            self._sched.block_until(
+                lambda: cell[0] and self._lock._owner is None
+            )
+        else:
+            self._sched.timeout_wait(
+                lambda: cell[0] and self._lock._owner is None
+            )
+            if not cell[0] and cell in self._waiters:
+                self._waiters.remove(cell)  # timed out un-notified
+        notified = cell[0]
+        # reacquire before returning, notified or not (threading semantics)
+        while self._lock._owner is not None:
+            self._sched.block_until(lambda: self._lock._owner is None)
+        self._lock._owner = t
+        return notified
+
+    def wait_for(self, predicate, timeout: float | None = None) -> bool:
+        while not predicate():
+            self.wait(timeout)
+            if timeout is not None and not predicate():
+                return predicate()
+        return True
+
+    def notify(self, n: int = 1) -> None:
+        for cell in self._waiters[:n]:
+            cell[0] = True
+        del self._waiters[:n]
+        self._sched.switch_point()
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters))
+
+
+class DetEvent:
+    def __init__(self, sched: DetScheduler):
+        self._sched = sched
+        self._flag = False
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        self._flag = True
+        self._sched.switch_point()
+
+    def clear(self) -> None:
+        self._flag = False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        if timeout is None:
+            self._sched.block_until(lambda: self._flag)
+        else:
+            self._sched.timeout_wait(lambda: self._flag)
+        return self._flag
+
+
+class DetQueue:
+    """queue.Queue with deterministic blocking/timeout semantics."""
+
+    def __init__(self, sched: DetScheduler, maxsize: int = 0):
+        self._sched = sched
+        self.maxsize = maxsize
+        self._items: deque = deque()
+
+    def _full(self) -> bool:
+        return self.maxsize > 0 and len(self._items) >= self.maxsize
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return self._full()
+
+    def put(self, item, block: bool = True, timeout: float | None = None) -> None:
+        self._sched.switch_point()
+        if self._full():
+            if not block:
+                raise _queue.Full
+            if timeout is not None:
+                if not self._sched.timeout_wait(lambda: not self._full()):
+                    raise _queue.Full
+            else:
+                self._sched.block_until(lambda: not self._full())
+        self._items.append(item)
+        self._sched.switch_point()
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        self._sched.switch_point()
+        if not self._items:
+            if not block:
+                raise _queue.Empty
+            if timeout is not None:
+                if not self._sched.timeout_wait(lambda: bool(self._items)):
+                    raise _queue.Empty
+            else:
+                self._sched.block_until(lambda: bool(self._items))
+        item = self._items.popleft()
+        self._sched.switch_point()
+        return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def task_done(self) -> None:
+        pass
+
+    def join(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# module shims
+
+
+class _ThreadingShim:
+    """Stands in for the ``threading`` module inside a patched module: the
+    synchronization constructors hand back scheduler-aware twins, everything
+    else (current_thread, TIMEOUT_MAX, ...) passes through."""
+
+    def __init__(self, sched: DetScheduler):
+        self._sched = sched
+
+    def Lock(self):  # noqa: N802 — threading API
+        return DetLock(self._sched)
+
+    def RLock(self):  # noqa: N802
+        return DetRLock(self._sched)
+
+    def Condition(self, lock=None):  # noqa: N802
+        return DetCondition(self._sched, lock)
+
+    def Event(self):  # noqa: N802
+        return DetEvent(self._sched)
+
+    def Thread(self, group=None, target=None, name=None, args=(), kwargs=None,
+               daemon=None):  # noqa: N802
+        return self._sched.spawn(
+            target, name=name or f"thread-{len(self._sched.threads)}",
+            args=args, kwargs=kwargs,
+        )
+
+    def __getattr__(self, name):
+        return getattr(threading, name)
+
+
+class _QueueShim:
+    def __init__(self, sched: DetScheduler):
+        self._sched = sched
+
+    def Queue(self, maxsize: int = 0):  # noqa: N802 — queue API
+        return DetQueue(self._sched, maxsize)
+
+    def __getattr__(self, name):
+        return getattr(_queue, name)
+
+
+@contextlib.contextmanager
+def patched_modules(sched: DetScheduler, modules: Iterable[object]):
+    """Swap each module's top-level ``threading``/``queue`` names for the
+    scheduler's shims for the duration of one schedule run."""
+    saved: list[tuple[object, str, object]] = []
+    try:
+        for mod in modules:
+            if getattr(mod, "threading", None) is threading:
+                saved.append((mod, "threading", threading))
+                setattr(mod, "threading", _ThreadingShim(sched))
+            if getattr(mod, "queue", None) is _queue:
+                saved.append((mod, "queue", _queue))
+                setattr(mod, "queue", _QueueShim(sched))
+        yield
+    finally:
+        for mod, name, orig in saved:
+            setattr(mod, name, orig)
+
+
+# --------------------------------------------------------------------------
+# exploration
+
+
+@dataclasses.dataclass
+class Scenario:
+    """One canned concurrency scenario.
+
+    ``setup(sched)`` builds fresh objects (inside the patched-module
+    context, so their locks are scheduler-aware) and returns
+    ``(thread_bodies, check)``; ``check()`` asserts the end-state
+    invariants after all threads finish. ``instrument`` lists source files
+    whose lines are preemption points; ``modules()`` returns the modules
+    whose threading/queue names get shimmed."""
+
+    name: str
+    setup: Callable
+    modules: Callable[[], list]
+    instrument: Callable[[], list[str]]
+    max_schedules: int = 64
+    random_mode: bool = False
+
+
+def _run_one(
+    scenario: Scenario, prefix: list[int], rng: random.Random | None
+) -> DetScheduler:
+    sched = DetScheduler(instrument_files=scenario.instrument())
+    with patched_modules(sched, scenario.modules()):
+        bodies, check = scenario.setup(sched)
+        threads = [
+            sched.spawn(body, name=f"t{i}") for i, body in enumerate(bodies)
+        ]
+        for t in threads:
+            t.started = True
+            t._thread.start()
+        sched.run(prefix, rng=rng)
+        if check is not None and not sched.violations:
+            try:
+                check()
+            except Exception as e:  # tpa: disable=TPA006 — the checker's contract: ANY invariant-check failure (assert, parse error, KeyError on torn state) is a schedule violation to report with its reproducing trace, not a crash
+                sched.violations.append(
+                    Violation(
+                        kind="invariant",
+                        detail=f"{type(e).__name__}: {e}"
+                        if not isinstance(e, AssertionError)
+                        else (str(e) or "invariant check failed"),
+                        schedule=sched.branch_trace(),
+                    )
+                )
+    return sched
+
+
+def explore(
+    scenario: Scenario,
+    max_schedules: int | None = None,
+    seed: int = 0,
+) -> ScenarioResult:
+    """Systematically explore ``scenario``'s interleavings up to the
+    schedule cap. DFS-with-replay over the decision tree (breadth-first
+    frontier: single-preemption schedules first), or seeded-random distinct
+    traces when the scenario opts into random mode."""
+    cap = max_schedules if max_schedules is not None else scenario.max_schedules
+    violations: list[Violation] = []
+    deadlocks = 0
+    max_decisions = 0
+    explored = 0
+
+    if scenario.random_mode:
+        seen: set[tuple] = set()
+        attempts = 0
+        while explored < cap and attempts < cap * 4:
+            attempts += 1
+            # int mix, not a tuple: hash-based Random seeding is deprecated.
+            rng = random.Random(seed * 1_000_003 + attempts)
+            sched = _run_one(scenario, [], rng)
+            trace = tuple(c for _, c in sched.decision_log)
+            if trace in seen:
+                continue
+            seen.add(trace)
+            explored += 1
+            max_decisions = max(max_decisions, len(sched.decision_log))
+            violations.extend(sched.violations)
+            deadlocks += int(sched.deadlocked)
+    else:
+        frontier: deque[list[int]] = deque([[]])
+        while frontier and explored < cap:
+            prefix = frontier.popleft()
+            sched = _run_one(scenario, prefix, None)
+            explored += 1
+            max_decisions = max(max_decisions, len(sched.decision_log))
+            violations.extend(sched.violations)
+            deadlocks += int(sched.deadlocked)
+            # Queue every untaken branch beyond the replayed prefix.
+            chosen_so_far = [c for _, c in sched.decision_log]
+            for i in range(len(prefix), len(sched.decision_log)):
+                n, chosen = sched.decision_log[i]
+                for alt in range(n):
+                    if alt != chosen:
+                        frontier.append(chosen_so_far[:i] + [alt])
+
+    return ScenarioResult(
+        name=scenario.name,
+        schedules=explored,
+        deadlocks=deadlocks,
+        violations=violations,
+        max_decisions=max_decisions,
+    )
+
+
+# --------------------------------------------------------------------------
+# canned scenarios
+
+
+def _module_file(mod) -> str:
+    return mod.__file__
+
+
+def _assert_prefix_cache_consistent(cache) -> None:
+    """Walk the trie under the cache's own lock and re-derive the byte/
+    block accounting from first principles."""
+    with cache._lock:
+        total = 0
+        blocks = 0
+        stack = [cache._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            assert n.refs >= 0, f"negative refcount {n.refs} on {n.edge}"
+            if n.blocks is not None:
+                total += n.nbytes
+                blocks += 1
+        assert total == cache._bytes, (
+            f"byte accounting drifted: nodes hold {total}, cache says "
+            f"{cache._bytes}"
+        )
+        assert blocks == cache.stats["blocks"], (
+            f"block count drifted: {blocks} reachable vs stats "
+            f"{cache.stats['blocks']}"
+        )
+        assert total <= cache.budget_bytes, "byte budget exceeded"
+
+
+def _scenario_prefix_cache(sched: DetScheduler):
+    import numpy as np
+
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.serve.prefix_cache import PrefixCache
+
+    cache = PrefixCache(ModelConfig(), block_tokens=2, budget_mb=1)
+    blk = np.zeros((1, 2, 2, 2), np.float32)
+
+    def read_block(start: int):
+        return [{"k": blk.copy(), "v": blk.copy()}]
+
+    # Shrink the budget to 3 blocks so the two threads contend over LRU
+    # eviction, pinning, and the byte accounting — the actual race surface.
+    cache.budget_bytes = 3 * 2 * blk.nbytes
+
+    def hammer(prompts):
+        def body():
+            for ids in prompts:
+                hit = cache.match(ids[: len(ids) - 1])
+                hit.stacked(16)
+                cache.insert(ids, (len(ids) // 2) * 2, read_block)
+                # Pinned blocks must never be evicted: every matched node
+                # stays attached to its parent until release().
+                with cache._lock:
+                    for n in hit._nodes:
+                        assert n.parent is not None and (
+                            n.parent.children.get(n.edge) is n
+                        ), "pinned block evicted while referenced"
+                hit.release()
+                _assert_prefix_cache_consistent(cache)
+        return body
+
+    a = [[1, 2, 3, 4, 5], [1, 2, 7, 8, 9]]
+    b = [[1, 2, 3, 4, 11], [13, 14, 15, 16, 17]]
+
+    def check():
+        _assert_prefix_cache_consistent(cache)
+        stack = [cache._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            assert n.refs == 0, f"leaked refcount {n.refs} on {n.edge}"
+
+    return [hammer(a), hammer(b)], check
+
+
+def _scenario_registry(sched: DetScheduler, registry_factory=None):
+    from transformer_tpu.obs.registry import MetricsRegistry
+
+    reg = (registry_factory or MetricsRegistry)()
+    reg.counter("warm_total", "pre-existing metric").inc()
+
+    def scraper():
+        for _ in range(2):
+            text = reg.to_prometheus_text()
+            for line in text.splitlines():
+                assert line.startswith("#") or len(line.split()) == 2, (
+                    f"torn exposition line: {line!r}"
+                )
+
+    def creator():
+        for i in range(4):
+            reg.counter(f"lazy_{i}_total", "created under scrape").inc()
+
+    def check():
+        names = {m.name for m in reg}
+        assert {"warm_total", "lazy_0_total", "lazy_3_total"} <= names
+
+    return [scraper, creator], check
+
+
+def _scenario_prefetch(sched: DetScheduler):
+    import numpy as np
+
+    from transformer_tpu.data import pipeline
+
+    batches = [
+        (np.full((2,), i, np.int32), np.full((2,), i, np.int32))
+        for i in range(3)
+    ]
+
+    def consumer():
+        gen = pipeline._threaded_device_prefetch(iter(batches), depth=1)
+        seen = 0
+        for _ in gen:
+            seen += 1
+            if seen >= 1:
+                break  # early exit mid-stream: the shutdown race
+        gen.close()
+        worker = sched.find_thread("pipeline-prefetch")
+        assert worker is not None, "producer thread never spawned"
+        assert worker.finished, (
+            "producer thread outlived the closed iterator (join missing)"
+        )
+
+    return [consumer], None
+
+
+def _scenario_eventlog(sched: DetScheduler, log_factory=None):
+    from transformer_tpu.obs.events import EventLog
+
+    buf = io.StringIO()
+    log = (log_factory or EventLog)(buf)
+
+    def writer(wid: int):
+        def body():
+            for i in range(3):
+                log.emit("schedules.test", writer=wid, seq=i)
+        return body
+
+    def check():
+        lines = buf.getvalue().splitlines()
+        assert len(lines) == 6, f"expected 6 events, got {len(lines)}"
+        for line in lines:
+            ev = json.loads(line)  # ValueError here = torn JSONL
+            assert ev["kind"] == "schedules.test"
+
+    return [writer(0), writer(1)], check
+
+
+def _pkg_files(*modnames: str) -> list[str]:
+    import importlib
+
+    return [
+        _module_file(importlib.import_module(m)) for m in modnames
+    ]
+
+
+def _pkg_modules(*modnames: str) -> list:
+    import importlib
+
+    return [importlib.import_module(m) for m in modnames]
+
+
+CANNED: dict[str, Scenario] = {
+    "prefix_cache_contention": Scenario(
+        name="prefix_cache_contention",
+        setup=_scenario_prefix_cache,
+        modules=lambda: _pkg_modules("transformer_tpu.serve.prefix_cache"),
+        instrument=lambda: _pkg_files("transformer_tpu.serve.prefix_cache"),
+        max_schedules=64,
+    ),
+    "registry_scrape_vs_create": Scenario(
+        name="registry_scrape_vs_create",
+        setup=_scenario_registry,
+        modules=lambda: _pkg_modules("transformer_tpu.obs.registry"),
+        instrument=lambda: _pkg_files("transformer_tpu.obs.registry"),
+        max_schedules=64,
+    ),
+    "prefetch_shutdown": Scenario(
+        name="prefetch_shutdown",
+        setup=_scenario_prefetch,
+        modules=lambda: _pkg_modules("transformer_tpu.data.pipeline"),
+        instrument=lambda: _pkg_files("transformer_tpu.data.pipeline"),
+        max_schedules=48,
+    ),
+    "eventlog_writers": Scenario(
+        name="eventlog_writers",
+        setup=_scenario_eventlog,
+        modules=lambda: _pkg_modules("transformer_tpu.obs.events"),
+        instrument=lambda: _pkg_files("transformer_tpu.obs.events"),
+        max_schedules=64,
+    ),
+}
+
+
+def run_scenarios(
+    names: Iterable[str] | None = None,
+    max_schedules: int | None = None,
+    seed: int = 0,
+) -> list[ScenarioResult]:
+    """Run the canned scenarios (all, or the named subset) and return their
+    results — the ``python -m transformer_tpu.analysis schedules`` payload."""
+    picked = list(names) if names else sorted(CANNED)
+    out = []
+    for name in picked:
+        if name not in CANNED:
+            raise KeyError(
+                f"unknown scenario {name!r}; available: {sorted(CANNED)}"
+            )
+        out.append(explore(CANNED[name], max_schedules=max_schedules, seed=seed))
+    return out

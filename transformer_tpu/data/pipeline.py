@@ -25,6 +25,8 @@ from __future__ import annotations
 import dataclasses
 import glob
 import os
+import queue
+import threading
 from collections.abc import Iterator
 
 import numpy as np
@@ -111,10 +113,12 @@ def _threaded_device_prefetch(
     overlap with device steps instead of serializing with them. Yields
     batches in EXACTLY the source iterator's order (bit-identical to the
     ``prefetch=False`` path — pinned by test); exceptions in the worker
-    re-raise at the consumer."""
-    import queue
-    import threading
-
+    re-raise at the consumer. The worker NEVER outlives the iterator: both
+    the exhausted path and an early consumer exit (break / exception /
+    generator close) drain the queue and join the thread before returning,
+    so its in-flight ``device_put`` buffers are released with it
+    (tests/test_data.py pins this; ``analysis/schedules.py
+    prefetch_shutdown`` explores the shutdown interleavings)."""
     import jax
 
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
@@ -138,7 +142,7 @@ def _threaded_device_prefetch(
                 if stop.is_set():
                     return
         except BaseException as e:  # noqa: BLE001  # tpa: disable=TPA006 — cross-thread reraise: the worker forwards EVERY failure to the consumer thread, which re-raises it; swallowing here would hang the consumer on a silent EOF instead
-            failure.append(e)
+            failure.append(e)  # tpa: disable=TPA101 — handoff, not a race: the consumer reads `failure` only after thread.join() below, a real happens-before edge
         finally:
             while not stop.is_set():
                 try:
@@ -162,6 +166,18 @@ def _threaded_device_prefetch(
             raise failure[0]
     finally:
         stop.set()
+        # Early exit (break, consumer exception, generator close) leaves
+        # the worker alive — possibly parked on a full queue with a
+        # device_put batch in hand. Drain the queue to unblock it and JOIN
+        # before returning: a daemon thread outliving the iterator would
+        # pin its in-flight device buffers for the rest of the process
+        # (and a future consumer could observe its stale queue).
+        while thread.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=0.05)
 
 
 @dataclasses.dataclass

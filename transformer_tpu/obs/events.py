@@ -16,10 +16,18 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
   bench-infra attribution (bench.py), so a flaky relay is diagnosable from
   the log after the fact.
 
-Writes are line-buffered and lock-guarded (the serve CLI's flush thread and
-its main loop share one log). A full disk must never kill the process being
-observed: OSError on write downgrades to a one-time stderr warning and the
-log goes quiet — telemetry is an instrument, not a dependency.
+Threading contract (machine-checked: the TPA1xx concurrency rules lint
+this module, ``analysis/schedules.py eventlog_writers`` explores
+concurrent-emit interleavings, and tests/test_obs.py hammers it with real
+threads): ``emit`` is MULTI-WRITER SAFE. One lock serializes every write —
+the serve CLI's scrape/flush threads, scheduler spans, and bench
+attribution can share one log and two events can never interleave bytes
+within a line (each line parses back as one JSON object). The
+``_broken``-sink state transitions under the same lock, so concurrent
+writers hitting a dead disk produce exactly one stderr warning. A full
+disk must never kill the process being observed: OSError on write
+downgrades to that warning and the log goes quiet — telemetry is an
+instrument, not a dependency.
 """
 
 from __future__ import annotations
@@ -51,31 +59,46 @@ class EventLog:
 
     def emit(self, kind: str, **fields) -> None:
         """Append one event. ``fields`` must be JSON-serializable; a ``ts``
-        stamp is added unless the caller supplies one (bench.py backfills)."""
+        stamp is added unless the caller supplies one (bench.py backfills).
+        Safe to call from any thread: the line is serialized outside the
+        lock, the single ``write`` happens inside it."""
         if self._broken:
+            # Racy fast path — a dead sink must not keep paying json.dumps
+            # per emit; the authoritative re-check happens under the lock.
             return
         event = {"ts": fields.pop("ts", None) or round(time.time(), 6),
                  "kind": kind, **fields}
         line = json.dumps(event, sort_keys=False)
         try:
             with self._lock:
+                if self._broken:
+                    return
                 self._file.write(line + "\n")
         except (OSError, ValueError):  # ValueError: write to a closed file
+            if self._mark_broken():
+                print(
+                    f"obs: event log {self.path or '<stream>'} unwritable; "
+                    "telemetry disabled for this process",
+                    file=sys.stderr,
+                )
+
+    def _mark_broken(self) -> bool:
+        """Flip the sink dead under the lock; True for exactly one caller
+        (so N concurrent writers racing a dead disk warn once, not N
+        times)."""
+        with self._lock:
+            was = self._broken
             self._broken = True
-            print(
-                f"obs: event log {self.path or '<stream>'} unwritable; "
-                "telemetry disabled for this process",
-                file=sys.stderr,
-            )
+            return not was
 
     def flush(self) -> None:
-        if self._broken:
-            return
         try:
             with self._lock:
+                if self._broken:
+                    return
                 self._file.flush()
         except (OSError, ValueError):
-            self._broken = True
+            self._mark_broken()
 
     def close(self) -> None:
         self.flush()

@@ -100,10 +100,15 @@ class Histogram:
 class MetricsRegistry:
     """Name-keyed get-or-create store for the three metric kinds.
 
-    Creation is locked (sinks may run on a flush thread); recording on an
-    already-created metric is plain float arithmetic — per-metric locks would
-    cost more than the races they prevent, and every recorder in this repo
-    is single-threaded per metric.
+    Threading contract (machine-checked: the TPA1xx concurrency rules lint
+    this module, and ``analysis/schedules.py registry_scrape_vs_create``
+    explores scrape-vs-lazy-creation interleavings — its revert-the-lock
+    canary reproduces the pre-fix race): creation AND iteration take
+    ``self._lock``, so the /metrics scrape thread can walk the registry
+    while the observed loop lazily creates metrics. Recording on an
+    already-created metric is plain float arithmetic — per-metric locks
+    would cost more than the races they prevent, and every recorder in
+    this repo is single-threaded per metric.
     """
 
     def __init__(self) -> None:

@@ -49,14 +49,24 @@ layout verbatim), speculative decoding (restore only touches the prompt
 region; speculation only writes past it), per-request opt-out
 (``"cache_prefix": false`` neither reads nor feeds the cache).
 
-Host-side and single-threaded by design — the scheduler drives it at
-admission/retirement boundaries that already sync; nothing here touches
-the jitted hot path's shapes.
+Threading contract (machine-checked: the TPA1xx concurrency rules lint
+this module, and ``analysis/schedules.py prefix_cache_contention`` hammers
+match/insert/release/evict from two deterministic threads): ONE
+``threading.Lock`` (``self._lock``) guards every trie mutation — match,
+insert, eviction, refcount pin/release, and the byte/stats accounting.
+Today's scheduler drives the cache from a single thread, so the lock is
+uncontended noise-level overhead (one uncontended acquire per admission /
+retirement, far off the jitted hot path); it exists so the ROADMAP's
+multi-replica router can share one cache across serving threads without a
+redesign. ``read_block`` (the device fetch) is deliberately called OUTSIDE
+the lock — holding the cache lock across a device->host copy would be
+exactly the TPA105 blocking-under-lock bug the analysis flags.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, Sequence
 
 import numpy as np
@@ -99,7 +109,12 @@ class PrefixHit:
         program's compile set O(log(max_total / block)) instead of one per
         distinct hit length. Pad rows are zeros: they land at positions
         ``>= tokens``, which the offset causal mask already hides and the
-        suffix prefill overwrites in place."""
+        suffix prefill overwrites in place.
+
+        Runs WITHOUT the cache lock: the nodes are pinned (``match``
+        refcounted them under the lock), pinned nodes cannot be evicted,
+        and ``blocks`` is immutable once attached — so the big numpy
+        concatenation never stalls other threads' admissions."""
         if not self._nodes:
             return None
         B = self._cache.block_tokens
@@ -122,8 +137,9 @@ class PrefixHit:
         return out
 
     def release(self) -> None:
-        for node in self._nodes:
-            node.refs -= 1
+        with self._cache._lock:
+            for node in self._nodes:
+                node.refs -= 1
         self._nodes = []
 
 
@@ -164,6 +180,11 @@ class PrefixCache:
         self.cfg = cfg
         self.block_tokens = block_tokens
         self.budget_bytes = budget_mb * (1 << 20)
+        # THE threading contract: one lock for every trie mutation (match,
+        # insert, evict, pin/release) and the byte/stats accounting. The
+        # schedule checker's prefix_cache_contention scenario explores
+        # two-thread interleavings against exactly this guard.
+        self._lock = threading.Lock()
         self._root = _Node(None, ())
         self._clock = 0
         self._bytes = 0
@@ -180,18 +201,22 @@ class PrefixCache:
         """Longest block-aligned prefix of ``ids`` the trie holds. Callers
         pass the prompt MINUS its last token (``ids[:L-1]``): at least one
         token must still go through the model forward — the admission pick
-        needs next-token logits, and a restore produces none."""
-        self._clock += 1
+        needs next-token logits, and a restore produces none. The matched
+        nodes leave pinned (refcounted under the lock), so a concurrent
+        insert's eviction can never free blocks the caller is about to
+        restore."""
         B = self.block_tokens
-        node, nodes = self._root, []
-        for j in range(len(ids) // B):
-            child = node.children.get(tuple(ids[j * B : (j + 1) * B]))
-            if child is None:
-                break
-            child.last_used = self._clock
-            child.refs += 1
-            nodes.append(child)
-            node = child
+        with self._lock:
+            self._clock += 1
+            node, nodes = self._root, []
+            for j in range(len(ids) // B):
+                child = node.children.get(tuple(ids[j * B : (j + 1) * B]))
+                if child is None:
+                    break
+                child.last_used = self._clock
+                child.refs += 1
+                nodes.append(child)
+                node = child
         return PrefixHit(tokens=len(nodes) * B, _nodes=nodes, _cache=self)
 
     # ---- insertion + eviction --------------------------------------------
@@ -207,55 +232,71 @@ class PrefixCache:
         -> per-layer host buffers`` (the scheduler's jitted slot slice).
         Evicts LRU unpinned leaves to stay under the byte budget; a block
         that cannot fit (everything else pinned or interior) is dropped,
-        never force-stored. Returns the number of blocks evicted."""
-        self._clock += 1
+        never force-stored. Returns the number of blocks evicted.
+
+        The device->host fetch runs OUTSIDE the lock (blocking under a lock
+        is the TPA105 bug class); the trie is re-checked after reacquiring,
+        so a peer thread that stored the same block first simply wins and
+        the duplicate fetch is discarded. The descend path stays pinned
+        across the unlock — the parent a new block attaches to can never be
+        evicted mid-fetch."""
         B = self.block_tokens
         node, evicted, pinned = self._root, 0, []
+        with self._lock:
+            self._clock += 1
         try:
             for j in range(n_tokens // B):
                 key = tuple(ids[j * B : (j + 1) * B])
-                child = node.children.get(key)
-                if child is not None:
-                    child.last_used = self._clock
-                else:
+                with self._lock:
+                    child = node.children.get(key)
+                    if child is not None:
+                        # Pin the WHOLE descend path (existing nodes
+                        # included): the current node is a childless leaf
+                        # right up to the moment its child is attached, so
+                        # an unpinned one could be evicted by a peer's
+                        # _make_room — and the next block would then hang
+                        # off a detached parent, unreachable by any match
+                        # yet still counted in the byte budget.
+                        child.last_used = self._clock
+                        child.refs += 1
+                        pinned.append(child)
+                        node = child
+                        continue
                     if self._bytes_per_block and not self._can_fit(
                         self._bytes_per_block
                     ):
                         break  # budget unreachable: don't even fetch
-                    blocks = [
-                        {k: np.asarray(v) for k, v in layer.items()}
-                        for layer in read_block(j * B)
-                    ]
-                    nbytes = sum(
-                        a.nbytes for layer in blocks for a in layer.values()
-                    )
-                    self._bytes_per_block = nbytes
-                    freed = self._make_room(nbytes)
-                    if freed is None:
-                        break  # budget unreachable right now: drop the tail
-                    evicted += freed
-                    child = _Node(node, key)
-                    child.blocks = blocks
-                    child.nbytes = nbytes
+                blocks = [
+                    {k: np.asarray(v) for k, v in layer.items()}
+                    for layer in read_block(j * B)
+                ]
+                nbytes = sum(
+                    a.nbytes for layer in blocks for a in layer.values()
+                )
+                with self._lock:
+                    child = node.children.get(key)
+                    if child is None:
+                        self._bytes_per_block = nbytes
+                        freed = self._make_room(nbytes)
+                        if freed is None:
+                            break  # budget unreachable now: drop the tail
+                        evicted += freed
+                        child = _Node(node, key)
+                        child.blocks = blocks
+                        child.nbytes = nbytes
+                        node.children[key] = child
+                        self._bytes += nbytes
+                        self.stats["blocks"] += 1
+                        self.stats["inserted_blocks"] += 1
                     child.last_used = self._clock
-                    node.children[key] = child
-                    self._bytes += nbytes
-                    self.stats["blocks"] += 1
-                    self.stats["inserted_blocks"] += 1
-                # Pin the WHOLE descend path (existing nodes included, not
-                # just freshly created ones) until this insert finishes: the
-                # current node is a childless leaf right up to the moment
-                # its child is attached, so an unpinned one could be evicted
-                # by the next block's _make_room — and the new child would
-                # then hang off a detached parent, unreachable by any match
-                # yet still counted in the byte budget.
-                child.refs += 1
-                pinned.append(child)
-                node = child
+                    child.refs += 1
+                    pinned.append(child)
+                    node = child
         finally:
-            for child in pinned:
-                child.refs -= 1
-        self.stats["evicted_blocks"] += evicted
+            with self._lock:
+                for child in pinned:
+                    child.refs -= 1
+                self.stats["evicted_blocks"] += evicted
         return evicted
 
     def _can_fit(self, nbytes: int) -> bool:
@@ -264,7 +305,8 @@ class PrefixCache:
         (a node is unevictable iff it or ANY descendant is pinned — an
         unpinned chain evicts leaf by leaf). Checked BEFORE fetching a
         block off the device so an unreachable budget never pays the
-        device->host copy it is about to drop."""
+        device->host copy it is about to drop. Caller holds
+        ``self._lock``."""
         if nbytes > self.budget_bytes:
             return False
 
@@ -282,7 +324,8 @@ class PrefixCache:
         cannot be met (every candidate pinned/interior, or the block alone
         exceeds the whole budget). O(n) scan per eviction — the trie holds
         at most budget/block_bytes nodes, and this runs at retirement
-        boundaries, never on the decode hot path."""
+        boundaries, never on the decode hot path. Caller holds
+        ``self._lock``."""
         if nbytes > self.budget_bytes:
             return None
         evicted = 0
@@ -311,7 +354,9 @@ class PrefixCache:
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def block_count(self) -> int:
-        return self.stats["blocks"]
+        with self._lock:
+            return self.stats["blocks"]
