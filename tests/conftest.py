@@ -31,3 +31,9 @@ def pytest_configure(config):
         "shard_map, multi-process) — `pytest -m 'not slow'` is the fast "
         "core-parity path (see README)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (docs/ROBUSTNESS.md) — a fast "
+        "deterministic subset rides tier-1; the full sweep is also marked "
+        "slow (`pytest -m chaos` runs every drill)",
+    )

@@ -54,3 +54,11 @@ def swallow_everything(path):
             return f.read()
     except Exception:  # TPA006: swallows unrelated failures in library code
         return None
+
+
+def hot_retry(q):
+    while True:
+        try:
+            return q.get_nowait()
+        except KeyError:  # TPA007: retries forever with no backoff or bound
+            continue

@@ -66,3 +66,29 @@ def cleanup_handler(path, pool):
     except Exception:  # broad but re-raising: a cleanup pass-through
         pool.append(slot)
         raise
+
+
+def bounded_retry(q, time):
+    for _attempt in range(5):  # bounded loop: never flagged
+        try:
+            return q.get_nowait()
+        except KeyError:
+            continue
+    raise TimeoutError
+
+
+def backed_off_retry(q, time):
+    while True:
+        try:
+            return q.get_nowait()
+        except KeyError:  # backs off: the retry rate is bounded
+            time.sleep(0.01)
+            continue
+
+
+def condition_tested_retry(q, stop):
+    while not stop.is_set():  # loop test bounds it: never flagged
+        try:
+            return q.get_nowait()
+        except KeyError:
+            continue

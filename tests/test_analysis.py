@@ -172,6 +172,52 @@ _CASES = [
             raise
     """,
     ),
+    (
+        "TPA007",
+        _HEADER + """
+    def drain(q):
+        while True:
+            try:
+                return q.get_nowait()
+            except KeyError:
+                continue
+    """,
+        _HEADER + """
+    import time
+
+    def drain(q):
+        while True:
+            try:
+                return q.get_nowait()
+            except KeyError:
+                time.sleep(0.01)  # backoff bounds the retry rate
+                continue
+
+    def drain_bounded(q):
+        for _attempt in range(5):  # bounded loop: never flagged
+            try:
+                return q.get_nowait()
+            except KeyError:
+                continue
+        raise TimeoutError
+
+    def drain_guarded(q, stop):
+        while not stop.is_set():  # loop test bounds it
+            try:
+                return q.get_nowait()
+            except KeyError:
+                continue
+
+    def drain_escapes(q):
+        while True:
+            try:
+                return q.get_nowait()
+            except KeyError:
+                if q.closed:
+                    raise
+                continue
+    """,
+    ),
 ]
 
 
@@ -279,7 +325,7 @@ def test_cli_bad_corpus_fires_every_rule(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert sorted(payload["counts"]) == [
-        "TPA001", "TPA002", "TPA003", "TPA004", "TPA005", "TPA006",
+        "TPA001", "TPA002", "TPA003", "TPA004", "TPA005", "TPA006", "TPA007",
     ]
 
 

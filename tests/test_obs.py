@@ -641,6 +641,70 @@ def test_summarize_snapshot_only_serve_log():
     assert "slot utilization" in text and "50.0%" in text
 
 
+def test_summarize_tolerates_truncated_final_line(tmp_path, capsys):
+    """A crash mid-write leaves the log's FINAL line torn — exactly the
+    shape a fault-injected sink or an OOM-killed server produces. The
+    summarize CLI must report the intact prefix, exit 0, and never raise;
+    a snapshot whose metrics payload is not a dict is skipped the same
+    way."""
+    from transformer_tpu.obs.__main__ import main as obs_main
+
+    jsonl = tmp_path / "crash.jsonl"
+    jsonl.write_text(
+        json.dumps({"ts": 1.0, "kind": "serve.request", "order": 0,
+                    "new_tokens": 3, "total_s": 0.5}) + "\n"
+        + json.dumps({"ts": 2.0, "kind": "metrics.snapshot",
+                      "metrics": "not-a-dict"}) + "\n"
+        + '{"ts": 3.0, "kind": "serve.request", "order": 1, "new_tok'
+    )
+    assert obs_main(["summarize", str(jsonl)]) == 0
+    out = capsys.readouterr().out
+    assert "2 events" in out          # torn line skipped, intact ones kept
+    assert "1 requests" in out
+
+    # byte-level truncation of a real log tail behaves the same
+    real = tmp_path / "real.jsonl"
+    real.write_text(
+        json.dumps({"ts": 1.0, "kind": "serve.request", "order": 0,
+                    "new_tokens": 2, "total_s": 0.25}) + "\n"
+        + json.dumps({"ts": 2.0, "kind": "serve.request", "order": 1,
+                      "new_tokens": 4, "total_s": 0.5}) + "\n"
+    )
+    real.write_bytes(real.read_bytes()[:-17])  # tear the final line
+    assert obs_main(["summarize", str(real), "--format", "json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["events"] == 1 and report["serve"]["requests"] == 1
+
+
+def test_summarize_breaker_degraded_time():
+    """serve.breaker transitions -> per-breaker opens + degraded seconds
+    (open/half-open time between open and the closing transition)."""
+    from transformer_tpu.obs.__main__ import render_text, summarize_events
+
+    events = [
+        {"ts": 10.0, "kind": "serve.breaker", "name": "speculative",
+         "state": "open", "previous": "closed"},
+        {"ts": 12.5, "kind": "serve.breaker", "name": "speculative",
+         "state": "half_open", "previous": "open"},
+        {"ts": 13.0, "kind": "serve.breaker", "name": "speculative",
+         "state": "closed", "previous": "half_open"},
+        {"ts": 20.0, "kind": "serve.breaker", "name": "prefix_cache",
+         "state": "open", "previous": "closed"},
+        # never closes: degraded through end-of-log
+        {"ts": 26.0, "kind": "metrics.snapshot", "metrics": {}},
+    ]
+    report = summarize_events(events)
+    brk = report["serve"]["breakers"]
+    assert brk["speculative"]["opens"] == 1
+    assert brk["speculative"]["degraded_s"] == pytest.approx(3.0)
+    assert brk["speculative"]["final_state"] == "closed"
+    assert brk["prefix_cache"]["degraded_s"] == pytest.approx(6.0)
+    assert brk["prefix_cache"]["final_state"] == "open"
+    text = render_text(report)
+    assert "breakers:" in text and "degraded" in text
+    assert "[open]" in text  # still-degraded breakers are called out
+
+
 def test_summarize_grouped_serve_batches():
     from transformer_tpu.obs.__main__ import render_text, summarize_events
 

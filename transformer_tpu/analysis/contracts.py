@@ -47,6 +47,15 @@ Contracts:
   scheduler's own recording is inline host code at step boundaries):
   telemetry records host-side scalars and can never leak an operation into
   traced code.
+- **fault_plane_inert** — an ARMED fault plane (``serve.resilience``)
+  must leave the serving hot paths' jaxprs byte-identical to the
+  disarmed twin's: injection points live in host code between dispatches
+  (admission, drafter calls, sink writes), never inside a trace. Any
+  future "optimization" that threads a fault flag into a jitted function
+  — minting a recompile per breaker flip, the exact bug the
+  ``resilience_retrace_report`` budget guards at runtime — fails here
+  abstractly first. The check also proves the plane is LIVE while armed
+  (a fired point raises), so the identity is not vacuous.
 """
 
 from __future__ import annotations
@@ -544,6 +553,62 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
     return f"jaxpr-identical twins: {', '.join(checked)}"
 
 
+def check_fault_plane_inert(cfg: ModelConfig) -> str:
+    """Armed-vs-disarmed fault-plane twins of the serving hot paths must
+    trace to byte-identical jaxprs (see module docstring): the plane is
+    host-side by construction, and this contract keeps it that way."""
+    import re
+
+    from transformer_tpu.models.decoder import init_decoder_caches
+    from transformer_tpu.serve import resilience
+    from transformer_tpu.serve.scheduler import _pool_step, _slot_prefill
+
+    def canon(jaxpr) -> str:
+        return re.sub(r"0x[0-9a-f]+", "0x", str(jaxpr))
+
+    params = abstract_params(cfg)
+    slots, total = 2, 16
+    per_slot = jax.eval_shape(lambda: init_decoder_caches(cfg, 1, total))
+    pool = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((slots, *x.shape), x.dtype), per_slot
+    )
+    toks = jax.ShapeDtypeStruct((slots,), np.int32)
+    prompt = jax.ShapeDtypeStruct((1, 8), np.int32)
+    slot = jax.ShapeDtypeStruct((), np.int32)
+    start = jax.ShapeDtypeStruct((), np.int32)
+    step_raw = _pool_step.__wrapped__
+    prefill_raw = _slot_prefill.__wrapped__
+
+    def trace_all():
+        a = canon(jax.make_jaxpr(
+            lambda p, c, t: step_raw(p, c, t, cfg))(params, pool, toks))
+        b = canon(jax.make_jaxpr(
+            lambda p, c, s, pr, st: prefill_raw(p, c, s, pr, st, cfg, 0)
+        )(params, pool, slot, prompt, start))
+        return a, b
+
+    plane = resilience.FaultPlane.parse("serve.prefill:p=1")
+    disarmed = trace_all()
+    with resilience.active(plane):
+        armed = trace_all()
+        # Non-vacuous: the armed plane really fires at its host-side site.
+        fired = False
+        try:
+            resilience.maybe_fail("serve.prefill")
+        except resilience.InjectedFault:
+            fired = True
+        assert fired, "armed fault plane never fired — the contract is vacuous"
+    assert disarmed[0] == armed[0], (
+        "an armed fault plane changed the POOL step jaxpr — injection "
+        "leaked into traced serving code"
+    )
+    assert disarmed[1] == armed[1], (
+        "an armed fault plane changed the SLOT prefill jaxpr — injection "
+        "leaked into traced serving code"
+    )
+    return "jaxpr-identical armed/disarmed twins: pool_step, slot_prefill"
+
+
 # --------------------------------------------------------------------------
 # driver
 
@@ -567,6 +632,9 @@ _CONTRACTS: list[tuple[str, Callable[[ModelConfig], str], Callable[[ModelConfig]
     ("decode_shapes", check_decode_shapes, lambda c: not c.encoder_only),
     ("train_step_dtypes", check_train_step_dtypes, lambda c: True),
     ("telemetry_inert", check_telemetry_inert, lambda c: True),
+    # Fault injection serves the continuous-batching (decoder-only) tier;
+    # the armed/disarmed jaxpr identity covers its two hot-path shapes.
+    ("fault_plane_inert", check_fault_plane_inert, lambda c: c.decoder_only),
 ]
 
 

@@ -276,6 +276,70 @@ def prefix_cache_retrace_report(steps: int = 3) -> list[WatchDelta]:
     return sentinel.deltas()
 
 
+def resilience_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state serving WHILE circuit breakers flip: injected drafter
+    and prefix-cache faults open the breakers mid-run, requests keep
+    answering through the degraded path, the fault plane disarms, and
+    half-open probes close the breakers — all on ONE scheduler whose hot
+    paths (``_pool_verify``, ``_pick_pool_verify``, ``_slot_prefill``,
+    ``_slot_restore``, ``_slot_read_blocks``, ``_pool_rollback``) must
+    compile ZERO new programs after warmup. Degradation is a row-content /
+    admission-path change, never a shape change: breaker-open rows still
+    ride the static W-wide verify program and breaker-open admissions use
+    the same bucketed full-prefill widths a cache miss uses. Greedy
+    answers are asserted byte-identical before, during, and after the
+    breaker transitions (docs/ROBUSTNESS.md)."""
+    from transformer_tpu.serve import PrefixCache, resilience
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.resilience import FaultPlane
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg, params, tok = _tiny_lm_setup()
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        speculate_k=2, prefix_cache=cache,
+        breaker_threshold=2, breaker_cooldown_s=0.0, retry_backoff_ms=1.0,
+    )
+    wave = [
+        {"prompt": "the quick brown fox jumps"},
+        {"prompt": "the quick brown dog"},
+        {"prompt": "lazy"},
+    ]
+    # Warmup: two passes cover misses (full prefill buckets) AND
+    # hits/partial hits (restore pads + suffix buckets) — breaker-open
+    # admissions reuse the miss path's programs, so warmup covers the
+    # degraded mode too.
+    want = s.run([dict(r) for r in wave])
+    want2 = s.run([dict(r) for r in wave])
+    assert [r.get("continuation") for r in want] == [
+        r.get("continuation") for r in want2
+    ], "prefix-cache replay changed greedy answers"
+    sentinel = RetraceSentinel()
+    sentinel.watch("verify(_pool_verify)", sched._pool_verify, budget=0)
+    sentinel.watch("pick(_pick_pool_verify)", sched._pick_pool_verify, budget=0)
+    sentinel.watch("_slot_prefill", sched._slot_prefill, budget=0)
+    sentinel.watch("restore(_slot_restore)", sched._slot_restore, budget=0)
+    sentinel.watch("export(_slot_read_blocks)", sched._slot_read_blocks, budget=0)
+    sentinel.watch("rollback(_pool_rollback)", sched._pool_rollback, budget=0)
+    sentinel.snapshot()
+    for i in range(steps):
+        with resilience.active(
+            FaultPlane.parse("draft.propose:p=1,times=4;prefix.match:p=1,times=4")
+        ):
+            out = s.run([dict(r) for r in wave])  # breakers open mid-run
+        assert [r.get("continuation") for r in out] == [
+            r.get("continuation") for r in want
+        ], f"degraded round {i} changed greedy answers"
+        out = s.run([dict(r) for r in wave])      # probes close the breakers
+        assert [r.get("continuation") for r in out] == [
+            r.get("continuation") for r in want
+        ], f"recovered round {i} changed greedy answers"
+        assert s.breakers["speculative"].state == "closed"
+        assert s.breakers["prefix_cache"].state == "closed"
+    return sentinel.deltas()
+
+
 def train_retrace_report(steps: int = 3) -> list[WatchDelta]:
     """Steady-state training: one warmup step compiles; ``steps`` more
     same-shaped steps must not."""

@@ -30,6 +30,14 @@ Rule catalogue (docs/ANALYSIS.md has the long-form version):
   LIBRARY module (anything outside ``cli/`` and ``__main__`` entry points).
   Handlers that unconditionally re-raise (cleanup handlers ending in bare
   ``raise``) are structural pass-throughs and exempt.
+- **TPA007** — retry loop without backoff or attempt bound: a constant-true
+  ``while`` whose except handler just ``continue``s, with no sleep/backoff
+  call and no ``raise``/``break`` escape in the handler. Under a persistent
+  fault this spins hot forever — the failure shape the serving tier's
+  bounded-retry-with-jittered-backoff policy exists to prevent
+  (docs/ROBUSTNESS.md). Handlers that sleep/back off, re-raise, or break
+  are exempt; bounded loops (``for``, condition-tested ``while``) are
+  never flagged.
 
 The taint analysis is deliberately conservative-but-simple: values derived
 from non-static parameters of a jitted function are traced; ``.shape`` /
@@ -55,7 +63,14 @@ RULES: dict[str, str] = {
     "TPA004": "static/donate argnames/argnums do not match the jitted signature",
     "TPA005": "donated argument reused after the donating call",
     "TPA006": "broad `except Exception` in a library (non-CLI) module",
+    "TPA007": "retry loop without backoff or attempt bound (while True + "
+              "except-and-continue)",
 }
+
+# Call names (last dotted component) that count as backoff inside a retry
+# handler: sleeping, waiting on a condition/event, or an explicit backoff
+# helper all bound the retry rate.
+_BACKOFF_CALLS = frozenset({"sleep", "wait", "backoff", "backoff_ms"})
 
 # Attribute reads that are concrete (host-side) even on a tracer.
 _LAUNDER_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding", "aval"})
@@ -681,6 +696,78 @@ class _Module:
         return out
 
 
+    def rule_tpa007(self) -> list[Finding]:
+        if self.is_cli:
+            return []
+        out: list[Finding] = []
+        enclosing = _enclosing_symbols(self.tree)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.While):
+                continue
+            test = node.test
+            if not (isinstance(test, ast.Constant) and test.value):
+                continue  # condition-tested loops are bounded by their test
+            for handler in _loop_retry_handlers(node):
+                out.append(
+                    self.finding(
+                        "TPA007",
+                        handler,
+                        enclosing.get(id(node), "<module>"),
+                        "unbounded retry: `while True` whose handler "
+                        "continues without a sleep/backoff or attempt "
+                        "bound spins hot under a persistent fault — add "
+                        "jittered backoff and re-raise after N attempts",
+                    )
+                )
+        return out
+
+
+def _loop_retry_handlers(loop: ast.While) -> list[ast.ExceptHandler]:
+    """Except handlers that retry ``loop`` unboundedly: the handler's last
+    statement is ``continue`` and nothing in its body backs off (a
+    sleep/wait/backoff call), escapes (``raise``/``break``/``return``), or
+    re-raises. Only ``try`` statements whose ``continue`` actually binds
+    THIS loop are considered — nested loops and function defs are skipped
+    (their retry shapes are judged when their own loop is visited)."""
+    trys: list[ast.Try] = []
+    stack: list[ast.stmt] = list(loop.body)
+    while stack:
+        stmt = stack.pop()
+        if isinstance(
+            stmt,
+            (ast.While, ast.For, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            continue  # continue/break inside bind the inner construct
+        if isinstance(stmt, ast.Try):
+            trys.append(stmt)
+            stack.extend(stmt.body)
+            stack.extend(stmt.orelse)
+            stack.extend(stmt.finalbody)
+        elif isinstance(stmt, ast.If):
+            stack.extend(stmt.body)
+            stack.extend(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            stack.extend(stmt.body)
+    out: list[ast.ExceptHandler] = []
+    for t in trys:
+        for handler in t.handlers:
+            if not (handler.body and isinstance(handler.body[-1], ast.Continue)):
+                continue
+            bounded = False
+            for inner in ast.walk(handler):
+                if isinstance(inner, (ast.Raise, ast.Break, ast.Return)):
+                    bounded = True
+                    break
+                if isinstance(inner, ast.Call):
+                    fname = _dotted(inner.func)
+                    if fname and fname.split(".")[-1] in _BACKOFF_CALLS:
+                        bounded = True
+                        break
+            if not bounded:
+                out.append(handler)
+    return out
+
+
 def _enclosing_symbols(tree: ast.Module) -> dict[int, str]:
     """Map id(node) -> nearest enclosing function/class name, for reporting."""
     out: dict[int, str] = {}
@@ -899,6 +986,8 @@ def run_rules(
             raw.extend(m.rule_tpa005(registry))
         if "TPA006" in active:
             raw.extend(m.rule_tpa006())
+        if "TPA007" in active:
+            raw.extend(m.rule_tpa007())
         for f in raw:
             if m.suppressed(f):
                 continue

@@ -372,8 +372,19 @@ def flags_to_telemetry():
     from absl import logging
 
     from transformer_tpu.obs import EventLog, Telemetry
+    from transformer_tpu.obs.breaker import CircuitBreaker
 
-    events = EventLog(FLAGS.metrics_jsonl) if FLAGS.metrics_jsonl else None
+    events = None
+    if FLAGS.metrics_jsonl:
+        # Sink circuit breaker (docs/ROBUSTNESS.md): a transiently full
+        # disk costs an outage window with a half-open re-probe every 30s,
+        # not the rest of the process's telemetry. Direct EventLog
+        # construction (no breaker) keeps the historical
+        # first-failure-disables contract.
+        events = EventLog(
+            FLAGS.metrics_jsonl,
+            breaker=CircuitBreaker("event_sink", threshold=3, cooldown_s=30.0),
+        )
     telemetry = Telemetry(
         events=events,
         prom_path=f"{FLAGS.metrics_jsonl}.prom" if FLAGS.metrics_jsonl else None,

@@ -122,6 +122,37 @@ def define_serve_flags() -> None:
         "prefix-cache block granularity in tokens: prompts share stored KV "
         "in units of this many positions (smaller = finer matching, more "
         "trie overhead)")
+    flags.DEFINE_boolean(
+        "prefix_verify_checksums", True,
+        "re-verify each matched prefix-cache block's crc32 at admission "
+        "(corrupt blocks are dropped instead of silently restored — "
+        "docs/ROBUSTNESS.md). Costs O(matched KV bytes) of host CPU per "
+        "hit; disable to trade integrity checking for admission latency")
+    flags.DEFINE_integer(
+        "max_backlog", 0,
+        "bounded admission backpressure for the continuous-batching path: "
+        "submissions beyond this many queued-but-unadmitted requests answer "
+        "a structured 'backpressure' error immediately instead of growing "
+        "the queue (0 = unbounded, the historical behavior)")
+    flags.DEFINE_integer(
+        "admission_retries", 2,
+        "bounded retries (with jittered exponential backoff) for transient "
+        "admission faults on the continuous-batching path; exhausted "
+        "retries answer a structured 'transient' error")
+    flags.DEFINE_integer(
+        "breaker_threshold", 3,
+        "consecutive faults before a serving circuit breaker (speculative "
+        "decoding / prefix cache) fails its subsystem open to the plain "
+        "byte-parity path — docs/ROBUSTNESS.md")
+    flags.DEFINE_float(
+        "breaker_cooldown", 30.0,
+        "seconds an open circuit breaker waits before one half-open "
+        "re-probe of its subsystem")
+    flags.DEFINE_string(
+        "fault_spec", "",
+        "deterministic fault injection for chaos drills (docs/ROBUSTNESS.md "
+        "grammar), e.g. 'serve.prefill:p=0.25,seed=7;obs.emit:at=5'. "
+        "'' = disarmed (zero overhead)")
 
 
 def _parse_line(line: str, model_cfg) -> dict:
@@ -343,14 +374,19 @@ def serve_continuous(q: queue.Queue, sched, model_cfg, telemetry=None) -> None:
             try:
                 req = _route_lm_request(line, model_cfg)
             except _RoutingError as e:
-                sched.submit_done({"error": str(e)})
+                # Error-taxonomy codes (docs/ROBUSTNESS.md) ride along; the
+                # `error` string stays byte-identical to the grouped path's.
+                sched.submit_done({"error": str(e), "code": "routing"})
                 continue
             except Exception as e:  # noqa: BLE001 — bad line answers, never kills
-                sched.submit_done({"error": f"{type(e).__name__}: {e}"})
+                sched.submit_done(
+                    {"error": f"{type(e).__name__}: {e}", "code": "validation"}
+                )
                 continue
             sched.submit(req)
         sched.admit()
         sched.step()
+        sched.idle_backoff()
         for resp in sched.drain_ready():
             print(json.dumps(resp), flush=True)
     if telemetry is not None:
@@ -368,6 +404,14 @@ def main(argv) -> None:
     from transformer_tpu.cli.flags import flags_to_telemetry, maybe_force_platform
 
     maybe_force_platform()
+    if FLAGS.fault_spec:
+        # Arm the fault plane BEFORE any subsystem starts: injection points
+        # fire deterministically per (seed, point, call-index), so a chaos
+        # drill replays exactly (docs/ROBUSTNESS.md).
+        from transformer_tpu.serve import resilience
+
+        resilience.install(resilience.FaultPlane.parse(FLAGS.fault_spec))
+        logging.info("fault plane armed: %s", FLAGS.fault_spec)
     telemetry = flags_to_telemetry()
 
     from transformer_tpu.cli.translate import load_export
@@ -423,6 +467,7 @@ def main(argv) -> None:
                 model_cfg,
                 block_tokens=FLAGS.prefix_block,
                 budget_mb=FLAGS.prefix_cache_mb,
+                verify_checksums=FLAGS.prefix_verify_checksums,
             )
         sched = ContinuousScheduler(
             params, model_cfg, tgt_tok,
@@ -434,6 +479,10 @@ def main(argv) -> None:
             speculate_k=FLAGS.speculate_k,
             drafter=drafter,
             prefix_cache=prefix_cache,
+            max_backlog=FLAGS.max_backlog,
+            admission_retries=FLAGS.admission_retries,
+            breaker_threshold=FLAGS.breaker_threshold,
+            breaker_cooldown_s=FLAGS.breaker_cooldown,
         )
         serve_continuous(q, sched, model_cfg, telemetry=telemetry)
         if telemetry is not None:

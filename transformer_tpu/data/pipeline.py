@@ -35,6 +35,13 @@ from transformer_tpu.config import PAD_ID
 from transformer_tpu.data.seeding import epoch_rng
 from transformer_tpu.data.tokenizer import SubwordTokenizer
 
+# Fault-injection slot (``data.prefetch``): ``serve.resilience.install``
+# plants the plane's hook here so chaos tests can fail the prefetch worker
+# deterministically — the injected OSError rides the worker's existing
+# failure[] handoff and re-raises at the consumer, proving the cross-thread
+# error path end-to-end without this module importing the serve stack.
+fault_hook = None
+
 
 def corpus_files(dataset_path: str, split: str) -> tuple[list[str], list[str]]:
     """Glob the src/tgt line files for one split — the reference's file
@@ -129,6 +136,8 @@ def _threaded_device_prefetch(
     def worker() -> None:
         try:
             for item in it:
+                if fault_hook is not None:
+                    fault_hook("data.prefetch")
                 payload = jax.device_put(item)
                 # Bounded put that gives up if the consumer went away
                 # (early break / generator close): a daemon thread parked

@@ -103,6 +103,45 @@ def summarize_events(events: list[dict]) -> dict:
                 "request_acceptance": rate_h.snapshot(),
             }
 
+    # ---- serve: circuit breakers (degraded time) -------------------------
+    transitions = [e for e in events if e.get("kind") == "serve.breaker"]
+    if transitions:
+        per_name: dict[str, list[dict]] = {}
+        for t in transitions:
+            name = t.get("name")
+            if isinstance(name, str) and isinstance(t.get("ts"), (int, float)):
+                per_name.setdefault(name, []).append(t)
+        last_ts = max(
+            (e["ts"] for e in events if isinstance(e.get("ts"), (int, float))),
+            default=0.0,
+        )
+        breakers = {}
+        for name, ts in sorted(per_name.items()):
+            ts.sort(key=lambda t: t["ts"])
+            degraded = 0.0
+            degraded_since = None
+            opens = 0
+            for t in ts:
+                state = t.get("state")
+                if state in ("open", "half_open"):
+                    if state == "open":
+                        opens += 1
+                    if degraded_since is None:
+                        degraded_since = t["ts"]
+                elif state == "closed" and degraded_since is not None:
+                    degraded += t["ts"] - degraded_since
+                    degraded_since = None
+            if degraded_since is not None:
+                # Still degraded at end-of-log: count up to the last event.
+                degraded += max(0.0, last_ts - degraded_since)
+            breakers[name] = {
+                "opens": opens,
+                "degraded_s": round(degraded, 6),
+                "final_state": ts[-1].get("state"),
+            }
+        if breakers:
+            report.setdefault("serve", {})["breakers"] = breakers
+
     # ---- serve: grouped-path batches --------------------------------------
     batches = [e for e in events if e.get("kind") == "serve.batch"]
     if batches:
@@ -120,6 +159,12 @@ def summarize_events(events: list[dict]) -> dict:
 
     # ---- serve: slot utilization from metric snapshots -------------------
     snaps = [e for e in events if e.get("kind") == "metrics.snapshot"]
+    if snaps:
+        # A crash-truncated final line never parses (read_events skips it),
+        # but a snapshot written by a DIFFERENT/older producer can carry a
+        # non-dict metrics payload — tolerate, never raise (the summarize
+        # CLI must work on exactly the logs crashes leave behind).
+        snaps = [s for s in snaps if isinstance(s.get("metrics"), dict)]
     if snaps:
         utils = []
         for s in snaps:
@@ -249,6 +294,16 @@ def render_text(report: dict) -> str:
                 f"  scheduler step: p50 {_fmt_s(step['p50'])}  "
                 f"p95 {_fmt_s(step['p95'])} over {step['count']} steps"
             )
+        brk = serve.get("breakers")
+        if brk:
+            parts = [
+                f"{name} {b['opens']} open(s), "
+                f"{_fmt_s(b['degraded_s'])} degraded"
+                + ("" if b.get("final_state") == "closed"
+                   else f" [{b.get('final_state')}]")
+                for name, b in sorted(brk.items())
+            ]
+            lines.append("  breakers: " + "; ".join(parts))
     grouped = report.get("serve_grouped")
     if grouped:
         line = (

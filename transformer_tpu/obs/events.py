@@ -39,13 +39,34 @@ import sys
 import threading
 import time
 
+# Fault-injection slot: ``serve.resilience.install`` plants the plane's
+# hook here (and clears it on uninstall) so the ``obs.emit`` chaos point
+# works WITHOUT this module importing resilience — obs stays jax-free and
+# serve-free by import structure (test-pinned). The injected exception
+# subclasses OSError on purpose: it flows through the same handler a full
+# disk would.
+fault_hook = None
+
 
 class EventLog:
-    """Append-only JSONL event writer."""
+    """Append-only JSONL event writer.
 
-    def __init__(self, path_or_file: "str | io.TextIOBase") -> None:
+    ``breaker`` (optional, duck-typed ``serve.resilience.CircuitBreaker``)
+    upgrades the permanent ``_broken`` downgrade to the graceful-degradation
+    ladder: K consecutive write failures OPEN the sink (events dropped,
+    one stderr warning per outage), a cooldown later one half-open emit
+    re-probes the disk, and success closes the breaker — a transiently
+    full disk costs an outage window, not the rest of the process's
+    telemetry. Without a breaker the historical contract holds: first
+    failure disables the sink for good, with exactly one warning.
+    """
+
+    def __init__(
+        self, path_or_file: "str | io.TextIOBase", breaker=None
+    ) -> None:
         self._lock = threading.Lock()
         self._broken = False
+        self._breaker = breaker
         if isinstance(path_or_file, str):
             d = os.path.dirname(os.path.abspath(path_or_file))
             os.makedirs(d, exist_ok=True)
@@ -66,6 +87,8 @@ class EventLog:
             # Racy fast path — a dead sink must not keep paying json.dumps
             # per emit; the authoritative re-check happens under the lock.
             return
+        if self._breaker is not None and not self._breaker.allow():
+            return  # sink open: drop quietly until the cooldown re-probe
         event = {"ts": fields.pop("ts", None) or round(time.time(), 6),
                  "kind": kind, **fields}
         line = json.dumps(event, sort_keys=False)
@@ -73,14 +96,32 @@ class EventLog:
             with self._lock:
                 if self._broken:
                     return
+                if fault_hook is not None:
+                    fault_hook("obs.emit")  # raises an OSError-shaped fault
                 self._file.write(line + "\n")
         except (OSError, ValueError):  # ValueError: write to a closed file
+            if self._breaker is not None:
+                self._record_sink_failure()
+                return
             if self._mark_broken():
                 print(
                     f"obs: event log {self.path or '<stream>'} unwritable; "
                     "telemetry disabled for this process",
                     file=sys.stderr,
                 )
+        else:
+            if self._breaker is not None:
+                self._breaker.record_success()
+
+    def _record_sink_failure(self) -> None:
+        """Feed the breaker; warn exactly when this failure OPENS it (one
+        warning per outage, whichever of emit/flush trips it)."""
+        if self._breaker.record_failure():
+            print(
+                f"obs: event log {self.path or '<stream>'} unwritable; "
+                "sink open (will re-probe after cooldown)",
+                file=sys.stderr,
+            )
 
     def _mark_broken(self) -> bool:
         """Flip the sink dead under the lock; True for exactly one caller
@@ -98,7 +139,13 @@ class EventLog:
                     return
                 self._file.flush()
         except (OSError, ValueError):
-            self._mark_broken()
+            if self._breaker is not None:
+                # A flush can be the fault that OPENS the sink; without the
+                # shared warn-on-trip the outage would start silently
+                # (emit()'s allow() short-circuits before any write).
+                self._record_sink_failure()
+            else:
+                self._mark_broken()
 
     def close(self) -> None:
         self.flush()

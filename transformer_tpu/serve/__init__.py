@@ -1,11 +1,24 @@
 """Serving-side scheduling: continuous (in-flight) batching over a fixed
 pool of KV-cache slots (``transformer_tpu/serve/scheduler.py``),
 speculative decoding — draft/verify/rollback on that pool
-(``transformer_tpu/serve/speculative.py``) — and the cross-request prefix
+(``transformer_tpu/serve/speculative.py``) — the cross-request prefix
 KV cache — radix-trie prompt reuse feeding slot admission
-(``transformer_tpu/serve/prefix_cache.py``)."""
+(``transformer_tpu/serve/prefix_cache.py``) — and the fault-tolerance
+surface: deterministic fault injection, request deadlines/cancellation,
+and the circuit-breaker degradation ladder
+(``transformer_tpu/serve/resilience.py``, docs/ROBUSTNESS.md)."""
 
-from transformer_tpu.serve.prefix_cache import PrefixCache, PrefixHit
+from transformer_tpu.serve.prefix_cache import (
+    PrefixCache,
+    PrefixCorruptionError,
+    PrefixHit,
+)
+from transformer_tpu.serve.resilience import (
+    CircuitBreaker,
+    FaultPlane,
+    InjectedFault,
+    TransientError,
+)
 from transformer_tpu.serve.scheduler import ContinuousScheduler, SlotPool
 from transformer_tpu.serve.speculative import (
     ModelDrafter,
@@ -15,10 +28,15 @@ from transformer_tpu.serve.speculative import (
 )
 
 __all__ = [
+    "CircuitBreaker",
     "ContinuousScheduler",
+    "FaultPlane",
+    "InjectedFault",
     "PrefixCache",
+    "PrefixCorruptionError",
     "PrefixHit",
     "SlotPool",
+    "TransientError",
     "ModelDrafter",
     "NgramDrafter",
     "drafter_from_flags",

@@ -67,11 +67,32 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import zlib
 from typing import Callable, Sequence
 
 import numpy as np
 
 from transformer_tpu.config import ModelConfig
+from transformer_tpu.serve.resilience import fired, maybe_fail
+
+
+class PrefixCorruptionError(RuntimeError):
+    """A stored KV block failed its checksum at match time. The corrupt
+    subtree has already been dropped and every pin taken by the failing
+    match released — the caller (scheduler admission) records a
+    prefix-cache breaker failure and serves the request by full prefill,
+    so a flipped bit degrades throughput, never answers."""
+
+
+def _block_crc(blocks: list[dict[str, np.ndarray]]) -> int:
+    """crc32 over one block's buffers in a deterministic (layer, key)
+    order — the integrity tag that turns silent KV corruption (bit rot, a
+    bad DMA, the ``prefix.corrupt`` chaos point) into a detected fault."""
+    crc = 0
+    for layer in blocks:
+        for key in sorted(layer):
+            crc = zlib.crc32(np.ascontiguousarray(layer[key]).tobytes(), crc)
+    return crc
 
 
 class _Node:
@@ -79,7 +100,10 @@ class _Node:
     ``block_tokens`` positions this node's depth covers, for every prompt
     sharing the root-to-here token path."""
 
-    __slots__ = ("children", "parent", "edge", "blocks", "nbytes", "last_used", "refs")
+    __slots__ = (
+        "children", "parent", "edge", "blocks", "nbytes", "last_used",
+        "refs", "crc",
+    )
 
     def __init__(self, parent: "_Node | None", edge: tuple[int, ...]):
         self.children: dict[tuple[int, ...], _Node] = {}
@@ -89,6 +113,7 @@ class _Node:
         self.nbytes = 0
         self.last_used = 0
         self.refs = 0
+        self.crc = 0
 
 
 @dataclasses.dataclass
@@ -165,6 +190,7 @@ class PrefixCache:
         *,
         block_tokens: int = 16,
         budget_mb: int = 64,
+        verify_checksums: bool = True,
     ):
         if cfg.attention_window:
             raise ValueError(
@@ -180,6 +206,7 @@ class PrefixCache:
         self.cfg = cfg
         self.block_tokens = block_tokens
         self.budget_bytes = budget_mb * (1 << 20)
+        self.verify_checksums = verify_checksums
         # THE threading contract: one lock for every trie mutation (match,
         # insert, evict, pin/release) and the byte/stats accounting. The
         # schedule checker's prefix_cache_contention scenario explores
@@ -193,6 +220,7 @@ class PrefixCache:
             "blocks": 0,
             "inserted_blocks": 0,
             "evicted_blocks": 0,
+            "corrupt_blocks": 0,
         }
 
     # ---- matching ---------------------------------------------------------
@@ -204,7 +232,15 @@ class PrefixCache:
         needs next-token logits, and a restore produces none. The matched
         nodes leave pinned (refcounted under the lock), so a concurrent
         insert's eviction can never free blocks the caller is about to
-        restore."""
+        restore.
+
+        Every matched block's crc32 is re-verified (outside the lock — the
+        pins make that safe) before the hit is returned: a corrupt block
+        drops its whole subtree and raises :class:`PrefixCorruptionError`
+        with zero pins left outstanding, so bit rot in stored KV can never
+        be silently restored into a slot. ``verify_checksums=False`` at
+        construction trades that guarantee back for the crc pass."""
+        maybe_fail("prefix.match")
         B = self.block_tokens
         with self._lock:
             self._clock += 1
@@ -217,7 +253,58 @@ class PrefixCache:
                 child.refs += 1
                 nodes.append(child)
                 node = child
+        if nodes and fired("prefix.corrupt"):
+            # Chaos point: flip one byte of the first matched block's
+            # stored buffers — the checksum pass below must catch it.
+            layer = nodes[0].blocks[0]
+            key = next(iter(sorted(layer)))
+            arr = layer[key]
+            raw = np.frombuffer(arr.tobytes(), np.uint8).copy()
+            raw[0] ^= 0xFF
+            layer[key] = np.frombuffer(raw.tobytes(), arr.dtype).reshape(
+                arr.shape
+            )
+        if self.verify_checksums:
+            for bad in nodes:
+                if _block_crc(bad.blocks) == bad.crc:
+                    continue
+                with self._lock:
+                    for n in nodes:
+                        n.refs -= 1
+                    self.stats["corrupt_blocks"] += 1
+                    self._drop_subtree(bad)
+                raise PrefixCorruptionError(
+                    f"prefix-cache block at depth {nodes.index(bad) + 1} "
+                    "failed its checksum; the corrupt subtree was dropped "
+                    "(or deferred until a peer's pins release)"
+                )
         return PrefixHit(tokens=len(nodes) * B, _nodes=nodes, _cache=self)
+
+    def _drop_subtree(self, node: _Node) -> None:
+        """Detach ``node`` (and everything under it — descendants are
+        unreachable once their ancestor is gone) after a checksum failure.
+        A subtree holding ANY peer pin is left in place instead: a
+        mid-insert peer has unlocked to fetch a block and will re-attach
+        under this path — detaching it now would let that attach land on an
+        unreachable parent, leaking byte-budget accounting forever (the
+        exact invariant ``insert``'s descend-path pinning documents). The
+        corrupt block stays detectable, so the next unpinned match drops
+        it. Idempotent under races: only the thread that actually detaches
+        adjusts the byte/stat accounting. Caller holds ``self._lock``."""
+        if node.parent is None or node.parent.children.get(node.edge) is not node:
+            return  # a peer's verify already dropped it
+        stack, subtree = [node], []
+        while stack:
+            n = stack.pop()
+            subtree.append(n)
+            stack.extend(n.children.values())
+        if any(n.refs for n in subtree):
+            return  # pinned by a peer (mid-insert/mid-restore): defer
+        del node.parent.children[node.edge]
+        for n in subtree:
+            if n.blocks is not None:
+                self._bytes -= n.nbytes
+                self.stats["blocks"] -= 1
 
     # ---- insertion + eviction --------------------------------------------
 
@@ -240,6 +327,7 @@ class PrefixCache:
         the duplicate fetch is discarded. The descend path stays pinned
         across the unlock — the parent a new block attaches to can never be
         evicted mid-fetch."""
+        maybe_fail("prefix.insert")
         B = self.block_tokens
         node, evicted, pinned = self._root, 0, []
         with self._lock:
@@ -284,6 +372,7 @@ class PrefixCache:
                         child = _Node(node, key)
                         child.blocks = blocks
                         child.nbytes = nbytes
+                        child.crc = _block_crc(blocks)
                         node.children[key] = child
                         self._bytes += nbytes
                         self.stats["blocks"] += 1
@@ -360,3 +449,16 @@ class PrefixCache:
     def block_count(self) -> int:
         with self._lock:
             return self.stats["blocks"]
+
+    def outstanding_refs(self) -> int:
+        """Total pins across the trie — 0 whenever no admission is
+        mid-restore and no insert is mid-fetch. The chaos suite asserts
+        this returns to 0 after every fault storm (a leaked pin would make
+        its block immortal under eviction)."""
+        with self._lock:
+            total, stack = 0, [self._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                total += n.refs
+            return total

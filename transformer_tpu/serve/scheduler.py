@@ -57,11 +57,27 @@ scheduler records per-request spans (enqueue→admit→prefill→first-token→
 finish), slot-occupancy/backlog gauges, and admission/retirement/error
 counters — all host-side at step boundaries: answers stay byte-identical
 and the hot path compiles the same programs (both pinned in tests).
+
+Fault tolerance (``serve/resilience.py``, docs/ROBUSTNESS.md): requests
+may carry ``deadline_ms`` (honored at queue/prefill/decode-step
+boundaries; expiry frees the slot and answers a structured ``deadline``
+error with the partial continuation), ``cancel(order)`` registers a
+cancellation from any thread that the scheduler loop executes at the next
+step boundary, ``max_backlog`` bounds admission with immediate
+``backpressure`` answers, and transient admission faults retry with
+jittered exponential backoff before answering ``transient``. Circuit
+breakers fail speculation and prefix reuse OPEN to the plain byte-parity
+path after K consecutive faults (half-open re-probe after a cooldown),
+with state exported as obs gauges + ``serve.breaker`` events — the chaos
+suite (tests/test_resilience.py) pins that fault storms lose no request,
+slot, or prefix pin, and that greedy answers return byte-identical once
+the breakers close, at zero steady-state recompiles.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from functools import partial
@@ -79,6 +95,15 @@ from transformer_tpu.models.transformer import (
     transformer_verify,
 )
 from transformer_tpu.ops.attention import insert_kv_blocks, slice_kv_blocks
+from transformer_tpu.serve.resilience import (
+    BREAKER_STATE_VALUE,
+    CircuitBreaker,
+    TransientError,
+    backoff_ms,
+    classify_error,
+    error_answer,
+    maybe_fail,
+)
 from transformer_tpu.serve.speculative import (
     NgramDrafter,
     build_verify_row,
@@ -257,6 +282,25 @@ def _pick_one(logits, base_key, position, temperature, *, sample, top_k, top_p):
 
 
 @dataclasses.dataclass
+class _Pending:
+    """One queued (not-yet-admitted) request."""
+
+    order: int
+    req: dict
+    t_enqueue: float
+    # Absolute perf_counter deadline (submit time + deadline_ms), or None.
+    # Parsed leniently at submit — an unconvertible deadline_ms stays None
+    # here and raises the validation error at admission, where it answers
+    # this request alone.
+    deadline: float | None = None
+    # Bounded-retry state for transient admission faults: attempts so far,
+    # and the jittered-backoff timestamp before which admit() must not
+    # re-try this entry.
+    attempts: int = 0
+    not_before: float = 0.0
+
+
+@dataclasses.dataclass
 class _Active:
     """Host-side state of one occupied slot."""
 
@@ -295,6 +339,10 @@ class _Active:
     t_admit: float = 0.0
     t_prefill: float | None = None
     t_first: float | None = None
+    # Absolute perf_counter deadline (None = no deadline): checked at the
+    # queue, prefill, and decode-step boundaries; expiry frees the slot and
+    # answers a structured "deadline" error with the partial continuation.
+    deadline: float | None = None
 
 
 class SlotPool:
@@ -340,6 +388,13 @@ class ContinuousScheduler:
         speculate_k: int = 0,
         drafter=None,
         prefix_cache=None,
+        max_backlog: int = 0,
+        admission_retries: int = 2,
+        retry_backoff_ms: float = 20.0,
+        drafter_slow_ms: float = 0.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        breaker_clock=time.monotonic,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -384,11 +439,47 @@ class ContinuousScheduler:
         self.num_slots = num_slots
         self._free = list(range(num_slots))
         self._active: dict[int, _Active] = {}
-        self._queue: deque[tuple[int, dict]] = deque()
-        self._enqueue_t: dict[int, float] = {}  # order -> submit() time
+        self._queue: deque[_Pending] = deque()
         self._done: dict[int, dict] = {}
         self._next_order = 0
         self._emit_next = 0
+        # Intake lock: submit/submit_done allocate output orders and append
+        # to the queue from CLIENT threads (the multi-replica router will
+        # have several); admission/stepping stay single-threaded on the
+        # scheduler's own loop.
+        self._intake_lock = threading.Lock()
+        # Orders whose cancellation was requested (order -> message):
+        # registered from ANY thread under the intake lock, EXECUTED by the
+        # scheduler loop at the next step boundary (_expire) — the queue
+        # answers, _active dict, slot pool, and stats are owned by the
+        # scheduler thread, so a client thread never mutates them.
+        self._cancel_pending: dict[int, str] = {}
+        # Queued entries carrying a deadline (maintained under the intake
+        # lock at every queue add/remove): lets the per-step expiry sweep
+        # skip its O(backlog) queue scan entirely in the common
+        # no-deadlines case, like the _cancel_pending guard below.
+        self._queued_deadlines = 0
+        # ---- resilience knobs (docs/ROBUSTNESS.md) ------------------------
+        self.max_backlog = max_backlog          # 0 = unbounded (historical)
+        self.admission_retries = max(0, admission_retries)
+        self.retry_backoff_ms = retry_backoff_ms
+        self.drafter_slow_ms = drafter_slow_ms
+        # Circuit breakers: fail speculation / prefix reuse OPEN to the
+        # plain byte-parity path after `threshold` consecutive faults; one
+        # half-open probe per `cooldown_s` decides recovery. Both always
+        # exist (record/allow are cheap) so degraded-mode logic has one
+        # shape with or without telemetry.
+        self._brk_spec = CircuitBreaker(
+            "speculative", threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s, clock=breaker_clock,
+            on_transition=self._on_breaker_transition,
+        )
+        self._brk_prefix = CircuitBreaker(
+            "prefix_cache", threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s, clock=breaker_clock,
+            on_transition=self._on_breaker_transition,
+        )
+        self.breakers = {b.name: b for b in (self._brk_spec, self._brk_prefix)}
         self.stats = {
             "admitted": 0, "steps": 0, "max_active": 0,
             # Prefix-cache accounting (host-side, filled at admission):
@@ -396,6 +487,11 @@ class ContinuousScheduler:
             # the prefill forwards actually dispatched — decode_bench's
             # --prefix_reuse sweep derives "forwards saved" from these.
             "prompt_tokens": 0, "prefix_hit_tokens": 0, "prefill_forwards": 0,
+            # Resilience accounting (telemetry-free introspection for the
+            # chaos suite): transient-admission retries, deadline expiries,
+            # client cancellations, backpressure refusals.
+            "retries": 0, "deadline_expired": 0, "cancelled": 0,
+            "backpressure": 0,
         }
         # Telemetry (obs.Telemetry | None) records host-side scalars only, at
         # the step/admission boundaries that already exist — answers stay
@@ -453,22 +549,81 @@ class ContinuousScheduler:
                 self._m_prefix_evicted = reg.counter(
                     "serve_prefix_evicted_blocks_total",
                     "prefix-cache KV blocks evicted under the byte budget")
+            self._m_deadline = reg.counter(
+                "serve_deadline_expired_total",
+                "requests answered with a deadline error")
+            self._m_cancelled = reg.counter(
+                "serve_cancelled_total", "requests cancelled by the client")
+            self._m_backpressure = reg.counter(
+                "serve_backpressure_total",
+                "requests refused at submit (max_backlog)")
+            self._m_retries = reg.counter(
+                "serve_admission_retries_total",
+                "transient admission faults retried with backoff")
 
     # ---- request intake ---------------------------------------------------
 
+    def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
+        """Breaker state -> obs: a gauge (0 closed / 1 half-open / 2 open)
+        plus a ``serve.breaker`` event per transition — `obs summarize`
+        derives degraded-time from the event stream. Host-side only; no-op
+        without telemetry."""
+        if self._tel is None:
+            return
+        self._tel.registry.gauge(
+            f"serve_breaker_state_{name}",
+            "circuit-breaker state: 0 closed, 1 half-open, 2 open",
+        ).set(BREAKER_STATE_VALUE[new])
+        self._tel.emit("serve.breaker", name=name, state=new, previous=old)
+
     def submit(self, req: dict) -> int:
-        order = self._next_order
-        self._next_order += 1
-        self._queue.append((order, req))
-        self._enqueue_t[order] = time.perf_counter()
+        now = time.perf_counter()
+        refused = None  # the refusal message, captured INSIDE the lock —
+        # reading self._done[order] back after release would race the
+        # scheduler thread's drain_ready() popping it.
+        with self._intake_lock:
+            order = self._next_order
+            self._next_order += 1
+            if self.max_backlog and len(self._queue) >= self.max_backlog:
+                # Bounded admission backpressure: refuse NOW with a
+                # structured error instead of queueing without bound — the
+                # client sees a retryable condition while in-flight
+                # requests keep their latency.
+                self.stats["backpressure"] += 1
+                refused = (
+                    f"admission queue is full ({self.max_backlog} "
+                    "requests); retry after a backoff"
+                )
+                self._done[order] = error_answer("backpressure", refused)
+            else:
+                deadline = None
+                try:
+                    d = req.get("deadline_ms")
+                    if d is not None:
+                        deadline = now + float(d) / 1e3
+                except (TypeError, ValueError):
+                    pass  # _start re-parses and answers the validation error
+                self._queue.append(
+                    _Pending(order=order, req=req, t_enqueue=now,
+                             deadline=deadline)
+                )
+                if deadline is not None:
+                    self._queued_deadlines += 1
         if self._tel is not None:
             self._m_requests.inc()
+            if refused is not None:
+                self._m_backpressure.inc()
+                self._m_errors.inc()
+                self._tel.emit(
+                    "serve.request", order=order, total_s=0.0, error=refused,
+                )
         return order
 
     def submit_done(self, resp: dict) -> int:
-        order = self._next_order
-        self._next_order += 1
-        self._done[order] = resp
+        with self._intake_lock:
+            order = self._next_order
+            self._next_order += 1
+            self._done[order] = resp
         if self._tel is not None:
             self._m_requests.inc()
             if "error" in resp:
@@ -478,6 +633,46 @@ class ContinuousScheduler:
                 **({"error": resp["error"]} if "error" in resp else {}),
             )
         return order
+
+    def cancel(self, order: int, message: str = "cancelled by client") -> bool:
+        """Request cancellation of a queued or in-flight request. The
+        cancellation is REGISTERED here (any thread, intake lock only) and
+        EXECUTED by the scheduler loop at the next step boundary: the queue
+        entry is dropped or the slot freed, and a structured "cancelled"
+        error answers at the request's reserved output position, so
+        arrival-order draining is unaffected and no prefix-cache pin can be
+        left behind (admission releases its hit synchronously). Returns
+        False when ``order`` is unknown, already answered, or already being
+        cancelled; True means the cancellation will be honored unless the
+        request completes first (it answers exactly once either way — the
+        benign race of cancelling a finishing request)."""
+        with self._intake_lock:
+            if (
+                order in self._done            # answered, not yet drained
+                or order >= self._next_order   # never submitted
+                or order < self._emit_next     # answered and drained
+                or order in self._cancel_pending
+            ):
+                return False
+            self._cancel_pending[order] = message
+        return True
+
+    def _answer_cancelled(
+        self, order: int, message: str, t_enqueue: float | None = None
+    ) -> None:
+        """Answer a queued (never-admitted) cancellation — scheduler
+        thread only, like every other queue answer."""
+        self.stats["cancelled"] += 1
+        self._done[order] = error_answer("cancelled", message)
+        if self._tel is not None:
+            now = time.perf_counter()
+            self._m_cancelled.inc()
+            self._m_errors.inc()
+            span = {"order": order, "error": message}
+            if t_enqueue is not None:
+                span["queue_s"] = round(now - t_enqueue, 6)
+                span["total_s"] = round(now - t_enqueue, 6)
+            self._tel.emit("serve.request", **span)
 
     @property
     def busy(self) -> bool:
@@ -512,25 +707,192 @@ class ContinuousScheduler:
     def admit(self) -> None:
         """Fill free slots from the queue (prefill-into-slot). A request
         that fails validation/encoding answers with its error alone — it
-        never enters the pool, so it cannot poison co-batched requests."""
-        while self._free and self._queue:
-            order, req = self._queue.popleft()
-            t_enq = self._enqueue_t.pop(order, 0.0)
+        never enters the pool, so it cannot poison co-batched requests.
+        Transient faults (:class:`TransientError`, e.g. an injected prefill
+        fault or a flaky device) get up to ``admission_retries`` re-tries
+        with jittered exponential backoff before answering a structured
+        "transient" error; entries whose backoff has not elapsed are
+        skipped this tick, not dropped."""
+        now = time.perf_counter()
+        deferred: list[_Pending] = []
+        while self._free:
+            with self._intake_lock:
+                # Pops (and the extendleft below) take the intake lock so
+                # cancel()'s queue scan from a client thread never observes
+                # a deque mutating under its iteration.
+                if not self._queue:
+                    break
+                p = self._queue.popleft()
+                if p.deadline is not None:
+                    self._queued_deadlines -= 1
+            if p.not_before > now:
+                deferred.append(p)
+                continue
+            if p.deadline is not None and now >= p.deadline:
+                self._answer_expired(p, now)
+                continue
+            with self._intake_lock:
+                cancel_msg = self._cancel_pending.pop(p.order, None)
+            if cancel_msg is not None:
+                # Registered cancel caught before admission: answer without
+                # ever paying the prefill (or taking a slot).
+                self._answer_cancelled(p.order, cancel_msg, p.t_enqueue)
+                continue
             try:
-                self._start(order, req, t_enq)
+                self._start(p.order, p.req, p.t_enqueue)
+            except TransientError as e:
+                if p.attempts < self.admission_retries:
+                    p.attempts += 1
+                    p.not_before = now + backoff_ms(
+                        self.retry_backoff_ms, p.attempts - 1, p.order
+                    ) / 1e3
+                    deferred.append(p)
+                    self.stats["retries"] += 1
+                    if self._tel is not None:
+                        self._m_retries.inc()
+                    continue
+                self._answer_admission_error(p, e, now)
             except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — per-request isolation: ANY admission failure must answer this request alone, never kill co-batched ones
-                self._done[order] = {"error": f"{type(e).__name__}: {e}"}
-                if self._tel is not None:
-                    now = time.perf_counter()
-                    self._m_errors.inc()
-                    self._tel.emit(
-                        "serve.request", order=order,
-                        queue_s=round(now - t_enq, 6) if t_enq else 0.0,
-                        total_s=round(now - t_enq, 6) if t_enq else 0.0,
-                        error=self._done[order]["error"],
-                    )
+                self._answer_admission_error(p, e, now)
+        # Backoff-deferred entries return to the FRONT in arrival order:
+        # output order is fixed by `order` anyway, this just keeps queue
+        # scans (deadline expiry, cancel) seeing them.
+        if deferred:
+            with self._intake_lock:
+                self._queue.extendleft(reversed(deferred))
+                self._queued_deadlines += sum(
+                    1 for p in deferred if p.deadline is not None
+                )
+
+    def _answer_admission_error(
+        self, p: _Pending, e: BaseException, now: float
+    ) -> None:
+        self._done[p.order] = error_answer(
+            classify_error(e), f"{type(e).__name__}: {e}"
+        )
+        if self._tel is not None:
+            t_enq = p.t_enqueue
+            self._m_errors.inc()
+            self._tel.emit(
+                "serve.request", order=p.order,
+                queue_s=round(now - t_enq, 6) if t_enq else 0.0,
+                total_s=round(now - t_enq, 6) if t_enq else 0.0,
+                error=self._done[p.order]["error"],
+            )
+
+    def _answer_expired(self, p: _Pending, now: float) -> None:
+        """A queued request's deadline elapsed before a slot freed."""
+        self.stats["deadline_expired"] += 1
+        self._done[p.order] = error_answer(
+            "deadline",
+            f"deadline_ms elapsed after {round((now - p.t_enqueue) * 1e3)}ms "
+            "in the admission queue",
+        )
+        if self._tel is not None:
+            self._m_deadline.inc()
+            self._m_errors.inc()
+            self._tel.emit(
+                "serve.request", order=p.order,
+                queue_s=round(now - p.t_enqueue, 6),
+                total_s=round(now - p.t_enqueue, 6),
+                error=self._done[p.order]["error"],
+            )
+
+    def _expire(self, now: float) -> None:
+        """Deadline sweep at a step boundary: queued requests whose
+        deadline passed answer without ever taking a slot; in-flight ones
+        free their slot mid-generation (the emitted prefix rides along as
+        ``"partial"``)."""
+        expired_q: list[_Pending] = []
+        if self._queued_deadlines:
+            with self._intake_lock:
+                # Scan under the intake lock: client threads append to the
+                # deque concurrently, and deque ITERATION (unlike popleft/
+                # append) is not atomic. Answers are emitted after release —
+                # telemetry takes locks of its own. The _queued_deadlines
+                # guard keeps this O(backlog) scan off the per-step path
+                # when no queued request carries a deadline.
+                expired_q = [
+                    p for p in self._queue
+                    if p.deadline is not None and now >= p.deadline
+                ]
+                for p in expired_q:
+                    self._queue.remove(p)
+                    self._queued_deadlines -= 1
+        for p in expired_q:
+            self._answer_expired(p, now)
+        if self._cancel_pending:
+            with self._intake_lock:
+                pending = dict(self._cancel_pending)
+                cancelled_q = [
+                    p for p in self._queue if p.order in pending
+                ]
+                for p in cancelled_q:
+                    self._queue.remove(p)
+                    if p.deadline is not None:
+                        self._queued_deadlines -= 1
+        else:
+            pending, cancelled_q = {}, []
+        for p in cancelled_q:
+            self._answer_cancelled(p.order, pending[p.order], p.t_enqueue)
+        for slot, st in list(self._active.items()):
+            if st.order in pending:
+                # Cancellation registered by cancel() (any thread),
+                # executed here on the scheduler thread that owns the pool.
+                self._abort(slot, st, "cancelled", pending[st.order])
+            elif st.deadline is not None and now >= st.deadline:
+                self._abort(
+                    slot, st, "deadline",
+                    f"deadline_ms elapsed after {len(st.emitted)} of "
+                    f"{st.max_new} tokens",
+                )
+        if pending:
+            # Retire executed/answered registrations; one mid-admission at
+            # this instant (popped from the queue, not yet in _active)
+            # stays pending and is swept right after its admission lands.
+            # An order that completed normally before its sweep was simply
+            # answered once, normally — the benign race cancel() documents.
+            with self._intake_lock:
+                for order in pending:
+                    if order in self._done or order < self._emit_next:
+                        self._cancel_pending.pop(order, None)
+
+    def _abort(self, slot: int, st: _Active, code: str, message: str) -> None:
+        """Free an occupied slot WITHOUT normal retirement (deadline expiry
+        or cancellation): the slot returns to the pool (admission resets
+        its cache index, so stale K/V is provably invisible to the next
+        occupant), nothing is fed to the prefix cache, and the request
+        answers a structured error carrying whatever was generated so far.
+        No prefix-cache pins can be outstanding here — admission releases
+        its hit synchronously before the request ever reaches a step
+        boundary."""
+        del self._active[slot]
+        self._free.append(slot)
+        resp = error_answer(code, message)
+        if st.emitted:
+            resp["partial"] = _detokenize_rows(
+                np.asarray([st.emitted], np.int32), 1, self.tok
+            )[0]
+        self._done[st.order] = resp
+        if code == "deadline":
+            self.stats["deadline_expired"] += 1
+        else:
+            self.stats["cancelled"] += 1
+        if self._tel is not None:
+            now = time.perf_counter()
+            (self._m_deadline if code == "deadline"
+             else self._m_cancelled).inc()
+            self._m_errors.inc()
+            self._tel.emit(
+                "serve.request", order=st.order,
+                prompt_tokens=st.prompt_len, new_tokens=len(st.emitted),
+                queue_s=round(st.t_admit - st.t_enqueue, 6),
+                total_s=round(now - st.t_enqueue, 6),
+                error=message,
+            )
 
     def _start(self, order: int, req: dict, t_enq: float = 0.0) -> None:
+        maybe_fail("serve.prefill")  # chaos point: admission-time fault
         prompt = str(req["prompt"])
         ids = [self.tok.bos_id, *self.tok.encode(prompt)]
         L = len(ids)
@@ -549,6 +911,14 @@ class ContinuousScheduler:
                 "or raise --serve_max_total"
             )
         max_new = min(max_new, self.max_total - 1 - L)
+        deadline = None
+        if req.get("deadline_ms") is not None:
+            # float() raising (e.g. "soon") answers a validation error for
+            # this request alone, like every other unconvertible field.
+            deadline = (
+                (t_enq or time.perf_counter())
+                + float(req["deadline_ms"]) / 1e3
+            )
         temperature = float(req.get("temperature", 0.0))
         sample = temperature > 0.0
         # Greedy never touches the rng or the truncation params: normalize
@@ -580,27 +950,46 @@ class ContinuousScheduler:
                 "cache refuses — resend with cache_prefix=false or serve "
                 "without attention_window"
             )
-        use_prefix = self.prefix_cache is not None and bool(
-            req.get("cache_prefix", True)
+        use_prefix = (
+            self.prefix_cache is not None
+            and bool(req.get("cache_prefix", True))
+            # Degradation ladder: while the prefix breaker is open, opted-in
+            # requests neither read nor feed the cache — they take the plain
+            # byte-parity full-prefill path (answers identical either way).
+            and self._brk_prefix.allow()
         )
         hit = None
         m = 0
+        prefix_ok = True  # no cache fault during THIS admission
         if use_prefix:
             # Match the prompt MINUS its last token: at least one token must
             # go through the model forward — the admission pick needs
             # next-token logits, which a block restore cannot produce.
-            hit = self.prefix_cache.match(ids[: L - 1])
-            m = hit.tokens
+            try:
+                hit = self.prefix_cache.match(ids[: L - 1])
+                m = hit.tokens
+            except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — prefix reuse is an optional accelerator: ANY cache failure (corrupt block, injected fault, trie bug) feeds the breaker and degrades THIS admission to full prefill; it must never answer the request with an error
+                self._brk_prefix.record_failure()
+                prefix_ok = False
+                hit, m = None, 0
         n_suffix = prefill_len_for(L - m, self.prefill_chunk)
         n = m + n_suffix
         slot = self._free.pop()
         t_admit = time.perf_counter()
         try:
             if m:
-                self.pool.caches = _slot_restore(
-                    self.pool.caches, jnp.int32(slot),
-                    hit.stacked(self.max_total + self.speculate_k),
-                )
+                try:
+                    self.pool.caches = _slot_restore(
+                        self.pool.caches, jnp.int32(slot),
+                        hit.stacked(self.max_total + self.speculate_k),
+                    )
+                except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — same degradation contract as the match above: a failed restore falls back to full prefill (the slot's index reset makes any partial restore invisible), feeding the breaker instead of erroring the request
+                    self._brk_prefix.record_failure()
+                    prefix_ok = False
+                    hit.release()
+                    hit, m = None, 0
+                    n_suffix = prefill_len_for(L, self.prefill_chunk)
+                    n = n_suffix
             logits, self.pool.caches = _slot_prefill(
                 self.params, self.pool.caches, jnp.int32(slot),
                 jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m), self.cfg,
@@ -612,6 +1001,10 @@ class ContinuousScheduler:
         finally:
             if hit is not None:
                 hit.release()
+        if use_prefix and prefix_ok:
+            # The cache served this admission end-to-end (hit or clean
+            # miss): a half-open probe closes the breaker here.
+            self._brk_prefix.record_success()
         self.stats["prompt_tokens"] += L
         self.stats["prefix_hit_tokens"] += m
         chunk = self.prefill_chunk
@@ -637,9 +1030,21 @@ class ContinuousScheduler:
             # ENQUEUED here, not finished; the full-prefill path syncs just
             # below at the first pick, making the span exact there.
             t_prefill=time.perf_counter(),
+            deadline=deadline,
         )
         self._active[slot] = st
         self.stats["max_active"] = max(self.stats["max_active"], len(self._active))
+        if deadline is not None and time.perf_counter() >= deadline:
+            # Prefill-boundary deadline check: the prompt ingest alone
+            # consumed the budget — answer now instead of decoding tokens
+            # the client has already given up on.
+            self.stats["admitted"] += 1
+            if self._tel is not None:
+                self._m_admissions.inc()
+            self._abort(
+                slot, st, "deadline", "deadline_ms elapsed during prefill"
+            )
+            return
         if n < L:
             st.cur = ids[n]  # un-prefilled prompt tail feeds token-by-token
         else:
@@ -669,6 +1074,7 @@ class ContinuousScheduler:
         slot on the plain path, up to ``speculate_k + 1`` on the
         speculative verify path. Retires finished slots; no-op when the
         pool is idle."""
+        self._expire(time.perf_counter())
         if not self._active:
             if self._tel is not None:
                 self._m_active.set(0)
@@ -753,12 +1159,36 @@ class ContinuousScheduler:
         keys = np.zeros((N, *np.shape(jax.random.PRNGKey(0))), np.uint32)
         positions = np.zeros((N,), np.int32)
         temps = np.ones((N,), np.float32)
+        # Degradation ladder: while the speculative breaker is open, no slot
+        # drafts — rows carry only the pending token (plus any prompt tail),
+        # which rides the SAME static-W verify program (zero recompiles) and
+        # is byte-identical to plain stepping for greedy AND sampled
+        # requests (no drafts = no rejection-sampling draws). A half-open
+        # probe re-consults the drafter after the cooldown.
+        spec_allowed = self.drafter is not None and self._brk_spec.allow()
         rows: dict[int, tuple[list[int], int]] = {}
         for slot, st in self._active.items():
-            row, n_drafted = build_verify_row(
-                st.ids + st.emitted, st.pos, self.speculate_k,
-                self.drafter if st.spec else None, st.dstate,
-            )
+            drafter = self.drafter if (st.spec and spec_allowed) else None
+            t_draft = time.perf_counter()
+            try:
+                row, n_drafted = build_verify_row(
+                    st.ids + st.emitted, st.pos, self.speculate_k,
+                    drafter, st.dstate,
+                )
+            except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — drafting is an optional accelerator: ANY drafter failure feeds the speculative breaker and this row degrades to no-lookahead (byte-identical answers); it must never kill the request, let alone the pool
+                self._brk_spec.record_failure()
+                row, n_drafted = build_verify_row(
+                    st.ids + st.emitted, st.pos, self.speculate_k, None, None,
+                )
+            else:
+                if drafter is not None:
+                    draft_ms = (time.perf_counter() - t_draft) * 1e3
+                    if self.drafter_slow_ms and draft_ms > self.drafter_slow_ms:
+                        # A drafter that stalls past its budget is as bad as
+                        # one that raises: speculation exists to SAVE time.
+                        self._brk_spec.record_failure()
+                    else:
+                        self._brk_spec.record_success()
             rows[slot] = (row, n_drafted)
             toks[slot, : len(row)] = row
             keys[slot] = st.key
@@ -894,7 +1324,10 @@ class ContinuousScheduler:
             st.cur = tokv
 
     def _finish(self, slot: int, st: _Active) -> None:
-        if self.prefix_cache is not None and st.use_prefix:
+        if (
+            self.prefix_cache is not None and st.use_prefix
+            and self._brk_prefix.allow()
+        ):
             # Feed the trie BEFORE the slot is recycled: slice the slot's
             # prompt-region KV (block-aligned; the cache's own storage
             # layout) into blocks. Only blocks the trie is missing are
@@ -908,17 +1341,26 @@ class ContinuousScheduler:
             B = self.prefix_cache.block_tokens
             aligned = (st.prompt_len // B) * B
             if aligned:
-                evicted = self.prefix_cache.insert(
-                    st.ids, aligned,
-                    lambda start: jax.device_get(
-                        _slot_read_blocks(
-                            self.pool.caches, jnp.int32(slot),
-                            jnp.int32(start), B,
-                        )
-                    ),
-                )
-                if evicted and self._tel is not None:
-                    self._m_prefix_evicted.inc(evicted)
+                try:
+                    evicted = self.prefix_cache.insert(
+                        st.ids, aligned,
+                        lambda start: jax.device_get(
+                            _slot_read_blocks(
+                                self.pool.caches, jnp.int32(slot),
+                                jnp.int32(start), B,
+                            )
+                        ),
+                    )
+                except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — feeding the trie is best-effort: a retirement-side cache fault (injected or real) feeds the breaker and this request simply does not donate its KV; its ANSWER is already computed and must still flush
+                    self._brk_prefix.record_failure()
+                else:
+                    # Mirrors the admission path: a clean feed closes a
+                    # half-open probe (without this, a breaker probed by a
+                    # RETIREMENT would stay half-open, where one isolated
+                    # fault re-opens it with the threshold bypassed).
+                    self._brk_prefix.record_success()
+                    if evicted and self._tel is not None:
+                        self._m_prefix_evicted.inc(evicted)
         text = _detokenize_rows(
             np.asarray([st.emitted], np.int32) if st.emitted
             else np.zeros((1, 0), np.int32),
@@ -974,6 +1416,23 @@ class ContinuousScheduler:
             self._emit_next += 1
         return out
 
+    def idle_backoff(self) -> None:
+        """Sleep out the shortest pending retry backoff when there is
+        nothing else to do (no active slots and every queued entry is
+        waiting out its jittered backoff) — the drive loops would otherwise
+        spin hot until the earliest ``not_before``. Bounded at 50ms so an
+        arriving request is never kept waiting long."""
+        if self._active or not self._queue:
+            return
+        now = time.perf_counter()
+        with self._intake_lock:  # deque iteration vs concurrent submits
+            qlen = len(self._queue)
+            waits = [
+                p.not_before - now for p in self._queue if p.not_before > now
+            ]
+        if waits and len(waits) == qlen:
+            time.sleep(min(min(waits), 0.05))
+
     def run(self, reqs: list[dict]) -> list[dict]:
         """Drive a fixed request list to completion; returns responses in
         request order."""
@@ -982,6 +1441,7 @@ class ContinuousScheduler:
         while self.busy:
             self.admit()
             self.step()
+            self.idle_backoff()
         out = self.drain_ready()
         if self._tel is not None:
             self._tel.maybe_flush(force=True)

@@ -59,7 +59,19 @@ from transformer_tpu.models.transformer import (
     transformer_verify,
 )
 from transformer_tpu.ops.attention import rollback_cache
+from transformer_tpu.serve.resilience import maybe_fail
 from transformer_tpu.train.decode import _bucket, prefill_len_for, sample_token
+
+
+def _drafter_fault_points() -> None:
+    """The two drafter chaos points (docs/ROBUSTNESS.md): ``draft.propose``
+    (a failing drafter — raises; the scheduler's speculative breaker
+    fails speculation open to the plain byte-parity path) and
+    ``draft.slow`` (a stalling drafter — sleeps ``ms=``; trips the
+    scheduler's slow-drafter budget and request deadlines). No-ops without
+    an installed plane."""
+    maybe_fail("draft.propose")
+    maybe_fail("draft.slow")
 
 
 class Drafter(Protocol):
@@ -127,6 +139,7 @@ class NgramDrafter:
     def propose(
         self, state: _NgramState | None, context: Sequence[int], k: int
     ) -> list[int]:
+        _drafter_fault_points()
         if state is None:  # stateless callers pay the one-shot index cost
             state = _NgramState()
         ctx = self._index(state, context)
@@ -216,6 +229,7 @@ class ModelDrafter:
     def propose(
         self, state: _DraftState, context: Sequence[int], k: int
     ) -> list[int]:
+        _drafter_fault_points()
         ctx = [int(t) for t in context]
         # The draft model's own position/buffer budget caps how far ahead
         # it can look; a capped (or empty) proposal list is always valid.

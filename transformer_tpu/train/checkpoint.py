@@ -37,11 +37,29 @@ import json
 import os
 import re
 import shutil
+import sys
+import zipfile
 from typing import Any
 
 import jax
 import numpy as np
 
+# Fault-injection slot (``ckpt.write``): ``serve.resilience.install``
+# plants the plane's hook here so chaos tests can fail a commit
+# deterministically without this module dragging the serve stack into
+# every train import. The injected exception subclasses OSError — it takes
+# the same path a dead disk would, and must leave the previous checkpoint
+# intact (the atomic tmp+rename commit guarantees it).
+fault_hook = None
+
+#: Failure shapes that mean "this checkpoint directory is torn/corrupt,
+#: try an older one" in ``restore_latest`` — truncated npz members
+#: (zipfile/OSError/EOFError), a half-written or garbled meta.json
+#: (json's ValueError), and structural mismatches from a partial write
+#: (KeyError "missing array", ValueError shape checks).
+_CORRUPT_CHECKPOINT_ERRORS = (
+    OSError, EOFError, KeyError, ValueError, zipfile.BadZipFile,
+)
 
 _SEP = "/"
 
@@ -145,6 +163,11 @@ class CheckpointManager:
         return tmp
 
     def _commit(self, tmp: str, step: int) -> None:
+        if fault_hook is not None:
+            # Pre-rename: an injected commit failure leaves the tmp dir
+            # behind and the previous checkpoint untouched — exactly the
+            # crash shape the atomic layout exists for.
+            fault_hook("ckpt.write")
         final = os.path.join(self.directory, f"ckpt_{step:08d}")
         if os.path.exists(final):
             shutil.rmtree(final)
@@ -398,11 +421,44 @@ class CheckpointManager:
                 h.close()
         return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
-    def restore_latest(self, target: Any) -> Any | None:
-        step = self.latest_step
-        if step is None:
-            return None
-        return self.restore(target, step)
+    def restore_latest(self, target: Any, on_fallback=None) -> Any | None:
+        """Restore the newest INTACT checkpoint: a torn/corrupt latest (a
+        crash mid-write on a filesystem without atomic rename, bit rot, a
+        truncated copy) falls back to the next-newest step with a warning
+        instead of killing the restart — the atomic commit makes older
+        steps trustworthy, so a resumable run should resume. Explicit
+        ``restore(target, step)`` still fails loudly: asking for a
+        specific step and silently getting another would be worse.
+
+        ``on_fallback(step, exc)`` (optional) is called per skipped
+        checkpoint on top of the stderr warning — the Trainer wires it to a
+        ``ckpt.fallback`` telemetry event.
+
+        If EVERY checkpoint fails, the last failure re-raises instead of
+        returning None: all-steps-unreadable is the signature of a
+        target/config mismatch (changed model shape, renamed params), not
+        of bit rot, and silently restarting from step 0 — then rotating
+        the good checkpoints away — would be far worse than dying loudly.
+        An empty directory still returns None (nothing to restore is the
+        normal first-run case)."""
+        steps = self.all_steps()
+        last_exc: Exception | None = None
+        for step in reversed(steps):
+            try:
+                return self.restore(target, step)
+            except _CORRUPT_CHECKPOINT_ERRORS as e:
+                last_exc = e
+                print(
+                    f"checkpoint: ckpt_{step:08d} in {self.directory} is "
+                    f"unreadable ({type(e).__name__}: {e}); falling back to "
+                    "the previous checkpoint",
+                    file=sys.stderr,
+                )
+                if on_fallback is not None:
+                    on_fallback(step, e)
+        if last_exc is not None:
+            raise last_exc
+        return None
 
     def wait(self) -> None:
         """No pending writes in the synchronous manager — see
@@ -491,9 +547,9 @@ class AsyncCheckpointManager(CheckpointManager):
         self.wait()  # never read a checkpoint mid-write
         return super().restore(target, step)
 
-    def restore_latest(self, target: Any) -> Any | None:
+    def restore_latest(self, target: Any, on_fallback=None) -> Any | None:
         self.wait()
-        return super().restore_latest(target)
+        return super().restore_latest(target, on_fallback=on_fallback)
 
 
 def average_checkpoints(

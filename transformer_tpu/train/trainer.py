@@ -681,7 +681,20 @@ class Trainer:
         rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
         # Restore BEFORE training (fixes reference restore-after, train.py:242-243).
         if self.checkpoint is not None:
-            restored = self.checkpoint.restore_latest(self.state)
+            def _ckpt_fallback(step, exc):
+                self.log_fn(
+                    f"checkpoint at step {step} unreadable "
+                    f"({type(exc).__name__}); falling back"
+                )
+                if self.telemetry is not None:
+                    self.telemetry.emit(
+                        "ckpt.fallback", step=int(step),
+                        reason=f"{type(exc).__name__}: {exc}",
+                    )
+
+            restored = self.checkpoint.restore_latest(
+                self.state, on_fallback=_ckpt_fallback
+            )
             if restored is not None:
                 self.state = restored
                 self.log_fn(f"restored checkpoint at step {int(self.state.step)}")
