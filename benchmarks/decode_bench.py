@@ -160,6 +160,24 @@ def main() -> None:
     decode_steps_s = args.decode_steps / best_dec
     decode_tok_s = args.batch * args.decode_steps / best_dec
 
+    # Cost-model predictions (analysis/costs.py, abstract trace — no device
+    # execution): the decode step's peak live-buffer bytes next to its
+    # measured tokens/s, so bench_rows.jsonl ties prediction to measurement
+    # and a memory regression shows up in the same file as a speed one.
+    from transformer_tpu.analysis.costs import program_costs
+
+    def _predict(fn, *abstract_args, donate_argnums=()):
+        return program_costs(
+            "bench", fn, *abstract_args, donate_argnums=donate_argnums
+        ).peak_bytes
+
+    decode_peak = _predict(
+        lambda p, t, c, pos: transformer_decode_step(
+            p, t, None, None, c, pos, cfg
+        ),
+        params, tok, caches, jnp.int32(0),
+    )
+
     # ---- speculative decoding sweep (batch-1, n-gram drafter) -------------
     # Headline: tokens emitted per target-model VERIFY forward — the number
     # speculation exists to push past 1.0 (incremental decode's ceiling).
@@ -173,11 +191,20 @@ def main() -> None:
             speculative_generate,
         )
 
+        from transformer_tpu.models.transformer import transformer_verify
+
         motif = rng.integers(1, args.vocab - 2, 8)
         spec_prompt = [int(motif[i % 8]) for i in range(args.prompt_len)]
         for k in ks:
             if k < 1:
                 continue
+            verify_peak = _predict(
+                lambda p, t, c, pos: transformer_verify(p, t, c, pos, cfg),
+                params,
+                jnp.zeros((1, k + 1), jnp.int32),
+                init_decoder_caches(cfg, 1, total),
+                jnp.int32(0),
+            )
             stats = {}
             toks: list = []
             best_spec = float("inf")
@@ -198,6 +225,7 @@ def main() -> None:
                 "acceptance_rate": round(acc, 4),
                 "verify_forwards": stats["verify_forwards"],
                 "new_tokens": len(toks),
+                "predicted_peak_bytes": verify_peak,
             })
 
     # ---- cross-request prefix reuse (continuous scheduler) ----------------
@@ -208,6 +236,18 @@ def main() -> None:
     prefix = None
     if args.prefix_reuse:
         from transformer_tpu.serve import ContinuousScheduler, PrefixCache
+        from transformer_tpu.serve.scheduler import (
+            _pool_step,
+            abstract_pool_caches,
+        )
+
+        pool_peak = _predict(
+            lambda p, c, t: _pool_step.__wrapped__(p, c, t, cfg),
+            params,
+            abstract_pool_caches(cfg, 2, total),
+            jnp.zeros((2,), jnp.int32),
+            donate_argnums=(1,),  # mirrors _pool_step's jit (and the budget)
+        )
 
         class _IdTok:
             """Tokens ARE ids ("3 17 5" -> [3, 17, 5]): the scheduler needs
@@ -274,6 +314,7 @@ def main() -> None:
             ),
             "wall_s_on": round(on["wall_s"], 3),
             "wall_s_off": round(off["wall_s"], 3),
+            "predicted_peak_bytes": pool_peak,
         }
 
     print(json.dumps({
@@ -282,6 +323,7 @@ def main() -> None:
         "decode_steps_per_sec": round(decode_steps_s, 1),
         "prefill_vs_decode": round(prefill_tok_s / decode_tok_s, 2),
         "prefill_forward_calls": prefill_calls,
+        "predicted_peak_bytes": decode_peak,
         "batch": args.batch,
         "prompt_len": args.prompt_len,
         "decode_steps": args.decode_steps,
@@ -306,6 +348,7 @@ def main() -> None:
             },
             "prefill_forwards_saved": prefix["prefill_forwards_saved"],
             "prefix_hit_tokens": prefix["prefix_hit_tokens"],
+            "predicted_peak_bytes": prefix["predicted_peak_bytes"],
             "device": f"{dev.platform}:{dev.device_kind}",
             "vs_baseline": None,
         })
@@ -332,6 +375,7 @@ def main() -> None:
                 },
                 "tokens_per_sec": s["tokens_per_sec"],
                 "acceptance_rate": s["acceptance_rate"],
+                "predicted_peak_bytes": s["predicted_peak_bytes"],
                 "device": f"{dev.platform}:{dev.device_kind}",
                 "vs_baseline": None,
             })

@@ -1,0 +1,49 @@
+"""Seeded known-BAD sharding corpus: every TPA20x rule must fire at least
+once when the CLI lints this file (tests/test_costs.py pins it, alongside
+the known-good twin that must stay silent). Never imported — parsed only."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+DEVICES = jax.devices()
+
+# Declares the axis vocabulary for this corpus: ("data", "model").
+MESH = Mesh(DEVICES, ("data", "model"))
+
+
+def train_step(state, batch):
+    return state
+
+
+def update(state, grads):
+    return state
+
+
+# TPA201: in_shardings pinned, out_shardings left to GSPMD propagation.
+sharded_step = jax.jit(train_step, in_shardings=(P("data"), P("data")))
+
+# TPA202: "modle" is a typo — not in the declared ("data", "model") mesh.
+ACT_SPEC = P("modle", None)
+
+# TPA203: argument 0 is donated but re-laid-out data -> model; the donation
+# silently degrades to a copy plus a reshard.
+donating_step = jax.jit(
+    update,
+    donate_argnums=(0,),
+    in_shardings=(P("data"), P(None)),
+    out_shardings=(P("model"),),
+)
+
+
+# TPA204: a collective inside the serving hot loop (_pool_* idiom).
+@jax.jit
+def _pool_step(params, caches, toks):
+    logits = jnp.ones((toks.shape[0], 8))
+    return jax.lax.psum(logits, "model")
+
+
+# TPA205: a large-parameter path (embedding table) fully replicated.
+PARTITION_RULES = [
+    (r"embedding/table$", P(None, None)),
+]

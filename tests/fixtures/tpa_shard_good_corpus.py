@@ -1,0 +1,58 @@
+"""Known-GOOD twin of tpa_shard_bad_corpus.py: the same shapes of code with
+the sharding discipline done right — every TPA20x rule must stay silent
+here (false positives on this file are rule bugs). Never imported."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+DEVICES = jax.devices()
+
+MESH = Mesh(DEVICES, ("data", "model"))
+
+
+def train_step(state, batch):
+    return state
+
+
+def update(state, grads):
+    return state
+
+
+# Boundary activations pinned on BOTH sides (cf. TPA201).
+sharded_step = jax.jit(
+    train_step,
+    in_shardings=(P("data"), P("data")),
+    out_shardings=(P("data"),),
+)
+
+# Axis names drawn from the declared vocabulary (cf. TPA202).
+ACT_SPEC = P("model", None)
+
+# Donated argument keeps its layout through the step (cf. TPA203).
+donating_step = jax.jit(
+    update,
+    donate_argnums=(0,),
+    in_shardings=(P("data"), P(None)),
+    out_shardings=(P("data"),),
+)
+
+
+# The serving hot loop stays collective-free (cf. TPA204).
+@jax.jit
+def _pool_step(params, caches, toks):
+    return jnp.ones((toks.shape[0], 8))
+
+
+# A collective in TRAIN code is fine — TPA204 scopes to the decode loop.
+@jax.jit
+def all_reduce_grads(grads):
+    return jax.lax.psum(grads, "data")
+
+
+# Large params sharded; only genuinely small tensors replicate (cf. TPA205).
+PARTITION_RULES = [
+    (r"embedding/table$", P("data", None)),
+    (r"ffn/in/kernel$", P("data", "model")),
+    (r"ln1/scale$", P(None)),
+]

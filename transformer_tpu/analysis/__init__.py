@@ -23,21 +23,38 @@ Three passes, one CLI (``python -m transformer_tpu.analysis``):
 - :mod:`.retrace` — compile-count sentinel (``_cache_size`` accounting)
   failing when the steady-state decode/train hot paths retrace beyond a
   declared budget, plus ``jax.checking_leaks`` wiring.
+- :mod:`.costs` — the jaxpr resource cost model: donation-aware peak
+  live-buffer bytes, dot/conv/reduce FLOPs, bytes moved, arithmetic
+  intensity, and KV-cache budgets per cache variant, gated against
+  checked-in budgets (``analysis/costs_baseline.json``).
+- :mod:`.sharding` — the collective inventory for ``shard_map`` programs
+  (kind, mesh axis, scan-weighted count, estimated comm bytes) plus
+  sharding lints TPA201–TPA205 (unconstrained boundary shardings,
+  mesh-axis typos, donation/layout mismatches, collectives in the decode
+  hot loop, replicated large params); baseline
+  ``analysis/sharding_baseline.json``.
+- :mod:`.baselines` — the shared finding/fingerprint/suppression/baseline
+  plumbing every lint family rides.
 
 Everything here is import-light: importing the package costs nothing until a
 pass actually runs (the lint rules never import the modules they analyze).
 """
 
+from transformer_tpu.analysis.baselines import Finding, RulesReport
 from transformer_tpu.analysis.concurrency import (
     CONCURRENCY_RULES,
     run_concurrency,
 )
 from transformer_tpu.analysis.contracts import ContractResult, run_contracts
+from transformer_tpu.analysis.costs import (
+    CostReport,
+    kv_cache_bytes,
+    program_costs,
+    run_costs,
+)
 from transformer_tpu.analysis.retrace import RetraceSentinel, leak_checking
 from transformer_tpu.analysis.rules import (
     RULES,
-    Finding,
-    RulesReport,
     run_rules,
 )
 from transformer_tpu.analysis.schedules import (
@@ -45,14 +62,26 @@ from transformer_tpu.analysis.schedules import (
     explore,
     run_scenarios,
 )
+from transformer_tpu.analysis.sharding import (
+    SHARDING_RULES,
+    collective_inventory,
+    run_sharding,
+)
 
 __all__ = [
     "RULES",
     "CONCURRENCY_RULES",
+    "SHARDING_RULES",
     "Finding",
     "RulesReport",
     "run_rules",
     "run_concurrency",
+    "run_sharding",
+    "CostReport",
+    "program_costs",
+    "kv_cache_bytes",
+    "run_costs",
+    "collective_inventory",
     "ScenarioResult",
     "explore",
     "run_scenarios",

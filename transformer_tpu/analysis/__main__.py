@@ -3,10 +3,14 @@
 Subcommands (all CPU-safe; exit code 0 = clean, 1 = findings/violations):
 
 - ``rules [--paths P ...] [--baseline FILE] [--update-baseline]`` — AST lint
-  rules TPA001–TPA006 over the package (or explicit paths).
+  rules TPA001–TPA007 over the package (or explicit paths).
 - ``concurrency [--paths P ...] [--baseline FILE] [--update-baseline]`` —
   concurrency rules TPA101–TPA105 (thread-root inference, shared-state
   guards, lock-order cycles, blocking-under-lock) over the same surface.
+- ``sharding [--paths P ...] [--baseline FILE] [--update-baseline]`` —
+  sharding lints TPA201–TPA205 (unconstrained boundary shardings, mesh-axis
+  typos, donation/layout mismatches, collectives in the decode hot loop,
+  replicated large params).
 - ``schedules [--max-schedules N] [--seed S] [--scenario NAME ...]`` — the
   deterministic interleaving checker: cooperatively explores thread
   schedules over canned serving-tier scenarios, asserting their invariants
@@ -15,6 +19,12 @@ Subcommands (all CPU-safe; exit code 0 = clean, 1 = findings/violations):
   via ``jax.eval_shape``/``jax.make_jaxpr`` (no device execution).
 - ``retrace [--steps N]`` — compile-count sentinel over the steady-state
   decode and train hot paths (0 new programs allowed after warmup).
+- ``costs [--baseline FILE] [--update-baseline]`` — the jaxpr cost model:
+  peak live-buffer bytes (donation-aware liveness), FLOPs, bytes moved,
+  arithmetic intensity, and the collective inventory for every canned
+  program, gated against ``analysis/costs_baseline.json`` budgets.
+- ``all [--only FAMILY,...]`` — every family above with ONE aggregate exit
+  code: the pre-merge gate (docs/ANALYSIS.md).
 
 ``--format=json`` emits machine-readable output on every subcommand so
 rounds can diff finding counts like a bench (``bench.py`` row style).
@@ -24,7 +34,35 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+
+def _ensure_cpu_devices(n: int = 8) -> None:
+    """Give jax-backed subcommands the same virtual 8-CPU-device platform
+    tests/conftest.py forces, so the sharded canned programs (costs /
+    sharding inventory) trace identically under the CLI and under pytest.
+    XLA reads the flags at backend initialization, which is lazy — so this
+    works even though importing ``transformer_tpu.analysis`` already
+    imported jax, as long as nothing has asked for devices yet. If a
+    backend IS already up with fewer devices, the multi-device programs are
+    skipped (and reported as such) rather than traced at different
+    shapes."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        # This environment may pre-register accelerator PJRT plugins via
+        # sitecustomize; flipping the config keeps the analyses CPU-only
+        # regardless (mirrors tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized on some platform; use as-is
 
 
 def _emit(payload: dict, text: str, fmt: str) -> None:
@@ -70,6 +108,102 @@ def _cmd_concurrency(args: argparse.Namespace) -> int:
     )
 
     return _lint_command(args, run_concurrency, default_concurrency_baseline_path)
+
+
+def _cmd_sharding(args: argparse.Namespace) -> int:
+    from transformer_tpu.analysis.sharding import (
+        default_sharding_baseline_path,
+        run_sharding,
+    )
+
+    return _lint_command(args, run_sharding, default_sharding_baseline_path)
+
+
+def _cmd_costs(args: argparse.Namespace) -> int:
+    _ensure_cpu_devices()
+    from transformer_tpu.analysis.costs import (
+        default_costs_baseline_path,
+        run_costs,
+        summarize,
+        write_costs_baseline,
+    )
+
+    baseline = args.baseline or default_costs_baseline_path()
+    result = run_costs(baseline_path=baseline, compare=not args.update_baseline)
+    if args.update_baseline:
+        # Programs skipped on this host (insufficient devices) keep their
+        # existing budget entries — updating from a small host must not
+        # silently drop the sharded collective budgets from CI.
+        from transformer_tpu.analysis.costs import load_costs_baseline
+
+        keep = {
+            name: entry
+            for name, entry in load_costs_baseline(baseline)
+            .get("programs", {})
+            .items()
+            if name in result.skipped
+        }
+        write_costs_baseline(result.reports, result.kv, baseline, keep=keep)
+        for name in result.skipped:
+            print(
+                f"warning: {name} skipped on this host — "
+                + ("existing budget carried forward"
+                   if name in keep else "NO budget exists for it"),
+                file=sys.stderr,
+            )
+        print(
+            f"budgeted {len(result.reports)} program(s) + "
+            f"{len(result.kv)} kv variant(s)"
+            + (f" (+{len(keep)} carried forward)" if keep else "")
+            + f" -> {baseline}"
+        )
+        return 0
+    _emit(result.to_dict(), summarize(result), args.format)
+    return 0 if result.ok else 1
+
+
+def _cmd_all(args: argparse.Namespace) -> int:
+    """Every analysis family, one aggregate exit code — the pre-merge gate."""
+    _ensure_cpu_devices()
+    ns = argparse.Namespace(
+        paths=None, baseline=None, update_baseline=False,
+        format=args.format, matrix="fast", steps=3,
+        scenario=None, max_schedules=64, seed=0,
+    )
+    families = {
+        "rules": _cmd_rules,
+        "concurrency": _cmd_concurrency,
+        "sharding": _cmd_sharding,
+        "schedules": _cmd_schedules,
+        "contracts": _cmd_contracts,
+        "retrace": _cmd_retrace,
+        "costs": _cmd_costs,
+    }
+    only = (
+        [f.strip() for f in args.only.split(",") if f.strip()]
+        if args.only else list(families)
+    )
+    unknown = [f for f in only if f not in families]
+    if unknown:
+        print(f"unknown famil{'y' if len(unknown) == 1 else 'ies'}: "
+              f"{', '.join(unknown)} (choose from {', '.join(families)})",
+              file=sys.stderr)
+        return 2
+    # In text mode each family gets a header; in json mode the output is a
+    # stream of family JSON objects (headers/summary ride stderr so the
+    # stream stays machine-readable).
+    info = sys.stdout if args.format == "text" else sys.stderr
+    results: dict[str, int] = {}
+    for name in only:
+        print(f"== {name} ==", file=info)
+        results[name] = families[name](ns)
+    failed = sorted(name for name, rc in results.items() if rc != 0)
+    print(
+        f"{len(results) - len(failed)}/{len(results)} families clean"
+        + (f" — FAILED: {', '.join(failed)}" if failed else ""),
+        file=info,
+    )
+    return 1 if failed else 0
 
 
 def _cmd_schedules(args: argparse.Namespace) -> int:
@@ -190,6 +324,46 @@ def main(argv: list[str] | None = None) -> int:
         help="grandfather every current finding into the baseline file",
     )
 
+    p_shard = sub.add_parser(
+        "sharding", help="sharding lint rules (TPA201-TPA205)"
+    )
+    p_shard.add_argument(
+        "--paths", nargs="*", default=None,
+        help="files/dirs to analyze (default: the transformer_tpu package)",
+    )
+    p_shard.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: analysis/sharding_baseline.json "
+        "for package runs)",
+    )
+    p_shard.add_argument(
+        "--update-baseline", action="store_true",
+        help="grandfather every current finding into the baseline file",
+    )
+
+    p_costs = sub.add_parser(
+        "costs", help="jaxpr cost model: peak bytes / FLOPs / collectives "
+        "vs. budget baselines"
+    )
+    p_costs.add_argument(
+        "--baseline", default=None,
+        help="budget JSON (default: analysis/costs_baseline.json)",
+    )
+    p_costs.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the budget baseline with the current numbers",
+    )
+
+    p_all = sub.add_parser(
+        "all", help="run every analysis family; one aggregate exit code "
+        "(the pre-merge gate)"
+    )
+    p_all.add_argument(
+        "--only", default=None,
+        help="comma-separated family subset (rules,concurrency,sharding,"
+        "schedules,contracts,retrace,costs)",
+    )
+
     p_sched = sub.add_parser(
         "schedules", help="deterministic interleaving checker (canned scenarios)"
     )
@@ -222,7 +396,10 @@ def main(argv: list[str] | None = None) -> int:
         help="steady-state iterations after warmup (default 3)",
     )
 
-    for p in (p_rules, p_conc, p_sched, p_contracts, p_retrace):
+    for p in (
+        p_rules, p_conc, p_shard, p_costs, p_all, p_sched, p_contracts,
+        p_retrace,
+    ):
         p.add_argument(
             "--format", choices=("text", "json"), default="text",
             help="output format (json is diff-able across rounds)",
@@ -232,6 +409,9 @@ def main(argv: list[str] | None = None) -> int:
     return {
         "rules": _cmd_rules,
         "concurrency": _cmd_concurrency,
+        "sharding": _cmd_sharding,
+        "costs": _cmd_costs,
+        "all": _cmd_all,
         "schedules": _cmd_schedules,
         "contracts": _cmd_contracts,
         "retrace": _cmd_retrace,

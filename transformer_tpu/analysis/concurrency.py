@@ -57,15 +57,15 @@ import dataclasses
 import os
 from typing import Iterable
 
-from transformer_tpu.analysis.rules import (
+from transformer_tpu.analysis.baselines import (
     Finding,
     RulesReport,
-    _dotted,
     _iter_py_files,
     _package_root,
-    _SUPPRESS_RE,
+    line_suppressed,
     load_baseline,
 )
+from transformer_tpu.analysis.rules import _dotted
 
 CONCURRENCY_RULES: dict[str, str] = {
     "TPA101": "unguarded access to state shared between thread roots",
@@ -506,15 +506,7 @@ class _ConcModule:
         )
 
     def suppressed(self, f: Finding) -> bool:
-        if not 0 < f.line <= len(self.lines):
-            return False
-        m = _SUPPRESS_RE.search(self.lines[f.line - 1])
-        if not m:
-            return False
-        codes = m.group(1)
-        if codes is None:
-            return True
-        return f.code in {c.strip() for c in codes.split(",")}
+        return line_suppressed(self.lines, f)
 
     # -- thread roots -------------------------------------------------------
 

@@ -528,14 +528,13 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
 
     # -- serving pool step (decoder-only exports) ---------------------------
     if cfg.decoder_only:
-        from transformer_tpu.models.decoder import init_decoder_caches
-        from transformer_tpu.serve.scheduler import _pool_step
+        from transformer_tpu.serve.scheduler import (
+            _pool_step,
+            abstract_pool_caches,
+        )
 
         slots, total = 2, 16
-        per_slot = jax.eval_shape(lambda: init_decoder_caches(cfg, 1, total))
-        pool = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct((slots, *x.shape), x.dtype), per_slot
-        )
+        pool = abstract_pool_caches(cfg, slots, total)
         toks = jax.ShapeDtypeStruct((slots,), np.int32)
         raw = _pool_step.__wrapped__
         plain, wrapped = twins(lambda p, c, t: raw(p, c, t, cfg))
@@ -559,19 +558,19 @@ def check_fault_plane_inert(cfg: ModelConfig) -> str:
     host-side by construction, and this contract keeps it that way."""
     import re
 
-    from transformer_tpu.models.decoder import init_decoder_caches
     from transformer_tpu.serve import resilience
-    from transformer_tpu.serve.scheduler import _pool_step, _slot_prefill
+    from transformer_tpu.serve.scheduler import (
+        _pool_step,
+        _slot_prefill,
+        abstract_pool_caches,
+    )
 
     def canon(jaxpr) -> str:
         return re.sub(r"0x[0-9a-f]+", "0x", str(jaxpr))
 
     params = abstract_params(cfg)
     slots, total = 2, 16
-    per_slot = jax.eval_shape(lambda: init_decoder_caches(cfg, 1, total))
-    pool = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct((slots, *x.shape), x.dtype), per_slot
-    )
+    pool = abstract_pool_caches(cfg, slots, total)
     toks = jax.ShapeDtypeStruct((slots,), np.int32)
     prompt = jax.ShapeDtypeStruct((1, 8), np.int32)
     slot = jax.ShapeDtypeStruct((), np.int32)

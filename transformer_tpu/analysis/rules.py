@@ -51,10 +51,22 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import json
 import os
-import re
 from typing import Iterable
+
+from transformer_tpu.analysis.baselines import (  # noqa: F401  (re-exports:
+    # Finding/RulesReport/load_baseline/write_baseline/_SUPPRESS_RE/
+    # _iter_py_files/_package_root are this module's historical public
+    # surface — concurrency.py and the tests import them from here)
+    Finding,
+    RulesReport,
+    _SUPPRESS_RE,
+    _iter_py_files,
+    _package_root,
+    line_suppressed,
+    load_baseline,
+    write_baseline,
+)
 
 RULES: dict[str, str] = {
     "TPA001": "Python if/while on a traced value inside a jitted function",
@@ -76,63 +88,6 @@ _BACKOFF_CALLS = frozenset({"sleep", "wait", "backoff", "backoff_ms"})
 _LAUNDER_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding", "aval"})
 # Calls whose result is concrete regardless of argument taint.
 _LAUNDER_CALLS = frozenset({"len", "isinstance", "type", "id", "repr", "str"})
-
-_SUPPRESS_RE = re.compile(r"#\s*tpa:\s*disable(?:\s*=\s*([A-Z0-9_,\s]+))?")
-
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    """One lint finding. ``fingerprint`` is line-number-free (code + file +
-    enclosing symbol + stripped source text) so baselines survive unrelated
-    edits above the finding."""
-
-    code: str
-    path: str
-    line: int
-    symbol: str
-    message: str
-    snippet: str
-
-    @property
-    def fingerprint(self) -> str:
-        return f"{self.code}:{self.path}:{self.symbol}:{self.snippet}"
-
-    def to_dict(self) -> dict:
-        return {
-            "code": self.code,
-            "path": self.path,
-            "line": self.line,
-            "symbol": self.symbol,
-            "message": self.message,
-            "snippet": self.snippet,
-            "fingerprint": self.fingerprint,
-        }
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: {self.code} [{self.symbol}] {self.message}"
-
-
-@dataclasses.dataclass
-class RulesReport:
-    findings: list[Finding]
-    baselined: list[Finding]
-    files_checked: int
-
-    @property
-    def counts(self) -> dict[str, int]:
-        out: dict[str, int] = {}
-        for f in self.findings:
-            out[f.code] = out.get(f.code, 0) + 1
-        return out
-
-    def to_dict(self) -> dict:
-        return {
-            "files_checked": self.files_checked,
-            "counts": self.counts,
-            "findings": [f.to_dict() for f in self.findings],
-            "baselined": [f.to_dict() for f in self.baselined],
-        }
-
 
 # --------------------------------------------------------------------------
 # small AST helpers
@@ -489,15 +444,7 @@ class _Module:
         )
 
     def suppressed(self, f: Finding) -> bool:
-        if not 0 < f.line <= len(self.lines):
-            return False
-        m = _SUPPRESS_RE.search(self.lines[f.line - 1])
-        if not m:
-            return False
-        codes = m.group(1)
-        if codes is None:
-            return True  # blanket `# tpa: disable`
-        return f.code in {c.strip() for c in codes.split(",")}
+        return line_suppressed(self.lines, f)
 
     # -- the rules ---------------------------------------------------------
 
@@ -902,41 +849,8 @@ def _scan_donation_reuse(
 # driver
 
 
-def _package_root() -> str:
-    import transformer_tpu
-
-    return os.path.dirname(os.path.abspath(transformer_tpu.__file__))
-
-
 def default_baseline_path() -> str:
     return os.path.join(_package_root(), "analysis", "baseline.json")
-
-
-def load_baseline(path: str | None) -> dict[str, str]:
-    """fingerprint -> justification. Missing file = empty baseline."""
-    if path is None or not os.path.exists(path):
-        return {}
-    with open(path, encoding="utf-8") as f:
-        data = json.load(f)
-    out: dict[str, str] = {}
-    for entry in data.get("findings", []):
-        out[entry["fingerprint"]] = entry.get("reason", "")
-    return out
-
-
-def _iter_py_files(paths: Iterable[str]) -> Iterable[tuple[str, str]]:
-    """(abs_path, display_path) for every .py under ``paths``."""
-    for p in paths:
-        p = os.path.abspath(p)
-        if os.path.isfile(p):
-            yield p, os.path.basename(p)
-            continue
-        for dirpath, dirnames, filenames in os.walk(p):
-            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-            for fname in sorted(filenames):
-                if fname.endswith(".py"):
-                    full = os.path.join(dirpath, fname)
-                    yield full, os.path.relpath(full, os.path.dirname(p))
 
 
 def run_rules(
@@ -1001,15 +915,3 @@ def run_rules(
     )
 
 
-def write_baseline(report: RulesReport, path: str, reason: str = "grandfathered") -> None:
-    """Persist every current finding as the new baseline (the `--update-
-    baseline` workflow: lint, eyeball, grandfather what stays)."""
-    payload = {
-        "findings": [
-            {"fingerprint": f.fingerprint, "reason": reason, "line": f.line}
-            for f in (*report.findings, *report.baselined)
-        ]
-    }
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(payload, f, indent=2, sort_keys=True)
-        f.write("\n")

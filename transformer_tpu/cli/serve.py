@@ -469,6 +469,25 @@ def main(argv) -> None:
                 budget_mb=FLAGS.prefix_cache_mb,
                 verify_checksums=FLAGS.prefix_verify_checksums,
             )
+        # Price the pool before allocating it: the cost model's dense-KV
+        # budget (analysis/costs.py — the number the paged-KV refactor is
+        # measured against) in the startup log, so an operator sees the
+        # device bytes a --serve_slots/--serve_max_total choice commits to.
+        from transformer_tpu.analysis.costs import kv_cache_bytes
+
+        # Same sizing as the scheduler's SlotPool: max_total plus the
+        # speculative lookahead slack (verify rows write k extra rows).
+        pool_tokens = (
+            FLAGS.serve_max_total or model_cfg.max_position + 1
+        ) + max(0, FLAGS.speculate_k)
+        kv = kv_cache_bytes(model_cfg, pool_tokens)
+        logging.info(
+            "slot pool KV budget: %d slots x %d bytes/slot = %.1f MiB "
+            "(%d bytes/token, dense max_len layout)",
+            FLAGS.serve_slots, kv["bytes_per_slot"],
+            FLAGS.serve_slots * kv["bytes_per_slot"] / (1 << 20),
+            kv["bytes_per_token"],
+        )
         sched = ContinuousScheduler(
             params, model_cfg, tgt_tok,
             num_slots=FLAGS.serve_slots,

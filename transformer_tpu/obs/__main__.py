@@ -214,11 +214,47 @@ def summarize_events(events: list[dict]) -> dict:
         compiles = [e for e in events if e.get("kind") == "train.compile"]
         if compiles:
             report["train"]["compiles"] = compiles[-1].get("cache_sizes")
-        mem = [e for e in events if e.get("kind") == "train.memory"]
-        if mem:
-            report["train"]["memory"] = mem[-1].get(
-                "devices", mem[-1].get("stats")
-            )
+
+    # ---- train: measured memory vs the cost model's prediction -----------
+    # The trainer records device.memory_stats() samples (train.memory) and,
+    # when the jaxpr cost model could price its step, one train.predicted
+    # event. Either side may be absent (older logs, un-traceable configs,
+    # backends without allocator stats) — report what exists, never raise.
+    mem = [e for e in events if e.get("kind") == "train.memory"]
+    if mem:
+        report.setdefault("train", {})["memory"] = mem[-1].get(
+            "devices", mem[-1].get("stats")
+        )
+    predicted = [e for e in events if e.get("kind") == "train.predicted"]
+    if predicted:
+        p = predicted[-1]
+        entry = {
+            k: p[k]
+            for k in ("peak_bytes", "flops", "bytes_moved", "tokens_per_step")
+            if isinstance(p.get(k), (int, float))
+        }
+        measured = None
+        for e in mem:
+            devices = e.get("devices")
+            if not isinstance(devices, dict):
+                continue
+            for stats in devices.values():
+                if isinstance(stats, dict) and isinstance(
+                    stats.get("peak_bytes_in_use"), (int, float)
+                ):
+                    peak = stats["peak_bytes_in_use"]
+                    measured = peak if measured is None else max(measured, peak)
+        if measured is not None:
+            entry["measured_peak_bytes"] = measured
+            if entry.get("peak_bytes"):
+                # > 1: the allocator holds more than the model predicts
+                # (fragmentation, workspace, other programs); << 1 or >> 1
+                # drift over rounds is the regression signal.
+                entry["measured_over_predicted"] = round(
+                    measured / entry["peak_bytes"], 3
+                )
+        if entry:
+            report.setdefault("train", {})["predicted"] = entry
 
     # ---- bench attribution ----------------------------------------------
     bench = [e for e in events if str(e.get("kind", "")).startswith("bench.")]
@@ -318,7 +354,8 @@ def render_text(report: dict) -> str:
     if train:
         tps = train.get("tokens_per_sec")
         lines.append(
-            f"train: {train['steps']} steps, {train['tokens']} tokens"
+            f"train: {train.get('steps', 0)} steps, "
+            f"{train.get('tokens', 0)} tokens"
             + (f", {tps:,.0f} tokens/s" if tps else "")
         )
         step = train.get("step_seconds")
@@ -337,6 +374,14 @@ def render_text(report: dict) -> str:
             lines.append(f"  jit programs compiled: {total} {train['compiles']}")
         if train.get("memory"):
             lines.append(f"  device memory: {train['memory']}")
+        pred = train.get("predicted")
+        if pred:
+            line = f"  cost model: predicted peak {pred.get('peak_bytes', '?')}B/step"
+            if pred.get("measured_peak_bytes") is not None:
+                line += f", measured peak {pred['measured_peak_bytes']}B"
+            if pred.get("measured_over_predicted") is not None:
+                line += f" (measured/predicted {pred['measured_over_predicted']}x)"
+            lines.append(line)
     bench = report.get("bench")
     if bench:
         lines.append(

@@ -1,0 +1,58 @@
+"""``shard_map`` across jax generations — one import for the whole package.
+
+Newer jax exposes ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+check_vma=..., axis_names=...)``; older releases only ship
+``jax.experimental.shard_map.shard_map(f, mesh, in_specs, out_specs,
+check_rep=..., auto=...)``. The two differ in exactly two spellings:
+
+- ``check_vma`` (new) == ``check_rep`` (old): verify the body's replication
+  claims against ``out_specs``.
+- ``axis_names`` (new) names the MANUAL axes; ``auto`` (old) names the
+  complement — the mesh axes left to GSPMD inside the region.
+
+Import ``shard_map`` from here instead of from jax: on a new jax the call
+passes straight through, on an old one the kwargs are translated. Without
+this shim, ``from jax import shard_map`` at module scope makes the whole
+``transformer_tpu.parallel`` package (and every test that touches it)
+unimportable on older jax — the seq/pipe/ring machinery would be gated on
+the newest release for the sake of two kwarg names.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+    axis_names: Any = None,
+):
+    """Dispatch to ``jax.shard_map`` when present, else translate to
+    ``jax.experimental.shard_map.shard_map``. ``axis_names=None`` means
+    every mesh axis is manual (both APIs' default)."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return native(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as legacy
+
+    auto = (
+        frozenset(mesh.axis_names) - frozenset(axis_names)
+        if axis_names is not None
+        else frozenset()
+    )
+    return legacy(
+        f, mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, auto=auto,
+    )

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from transformer_tpu.parallel.compat import shard_map
+
 from transformer_tpu.kernels.flash_attention import (
     _MASKED,
     _FlashConfig,
@@ -447,7 +449,7 @@ def make_sequence_parallel_attention(
             window=window,
         )
         if kv_mask is None:
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 lambda q, k, v: fn(q, k, v),
                 mesh=mesh,
                 in_specs=(act, act, act),
@@ -455,7 +457,7 @@ def make_sequence_parallel_attention(
                 check_vma=False,
             )
             return sharded(q, k, v)
-        sharded = jax.shard_map(
+        sharded = shard_map(
             lambda q, k, v, m: fn(q, k, v, kv_mask=m),
             mesh=mesh,
             in_specs=(act, act, act, mask_spec),

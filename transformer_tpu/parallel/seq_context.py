@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from transformer_tpu.parallel.compat import shard_map
+
 
 @dataclasses.dataclass(frozen=True)
 class SeqParallelContext:
@@ -116,7 +118,7 @@ def seq_parallel_attention(
         inner, axis_name=ctx.axis, axis_size=sp, causal=causal, window=window
     )
     if kv_mask is None:
-        sharded = jax.shard_map(
+        sharded = shard_map(
             lambda q, k, v: fn(q, k, v),
             mesh=mesh,
             in_specs=(act, act, act),
@@ -124,7 +126,7 @@ def seq_parallel_attention(
             check_vma=False,
         )
         return sharded(q, k, v)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         lambda q, k, v, m: fn(q, k, v, kv_mask=m),
         mesh=mesh,
         in_specs=(act, act, act, P(bdim, ctx.axis)),

@@ -45,7 +45,10 @@ _RULES: list[tuple[str, P]] = [
     # MoE (ops/moe.py): experts stacked on a leading E axis shard over
     # 'expert' (expert parallelism), composing with tp on dff and fsdp on
     # d_model exactly like the dense FFN; the router stays replicated.
-    (r"moe/router/kernel$", P(None, None)),
+    # The router is (M, E): a few KB, replicated by design so every token's
+    # routing decision is local (no gather before dispatch); the expert
+    # weights it routes TO are what's sharded.
+    (r"moe/router/kernel$", P(None, None)),  # tpa: disable=TPA205 — tiny by design
     (r"moe/in/kernel$", P("expert", "fsdp", "model")),
     (r"moe/in/bias$", P("expert", "model")),
     (r"moe/out/kernel$", P("expert", "model", "fsdp")),

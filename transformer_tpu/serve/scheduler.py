@@ -119,6 +119,19 @@ from transformer_tpu.train.decode import (
 )
 
 
+def abstract_pool_caches(cfg: ModelConfig, num_slots: int, max_total: int):
+    """The slot pool's KV cache pytree as ``ShapeDtypeStruct``s — the ONE
+    statement of the pool's device layout (per-slot caches from
+    ``init_decoder_caches`` stacked on a leading slot axis), shared by the
+    abstract analyses (``analysis/contracts.py`` jaxpr twins,
+    ``analysis/costs.py`` memory/FLOP budgets) so they can never drift from
+    what the scheduler actually allocates. Nothing is allocated here."""
+    per_slot = jax.eval_shape(lambda: init_decoder_caches(cfg, 1, max_total))
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((num_slots, *x.shape), x.dtype), per_slot
+    )
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def _pool_step(params, pool_caches, toks, cfg: ModelConfig):
     """One decode step for every slot: (N,) tokens -> ((N, V) logits,

@@ -679,6 +679,7 @@ class Trainer:
                 "to single-step dispatch"
             )
         rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
+        self._emit_cost_prediction()
         # Restore BEFORE training (fixes reference restore-after, train.py:242-243).
         if self.checkpoint is not None:
             def _ckpt_fallback(step, exc):
@@ -931,6 +932,41 @@ class Trainer:
         self.telemetry.emit(
             "train.eval", epoch=epoch + 1, step=step,
             loss=round(loss, 6), accuracy=round(acc, 6),
+        )
+
+    def _emit_cost_prediction(self) -> None:
+        """One ``train.predicted`` event at fit start: the jaxpr cost
+        model's peak-bytes/FLOPs estimate for THIS run's plain train step
+        (``analysis/costs.py``, abstract trace — no device execution).
+        ``obs summarize`` cross-checks it against the ``train.memory``
+        samples ``_record_epoch_telemetry`` records from
+        ``device.memory_stats()`` and reports the measured/predicted ratio.
+        Single-device prediction: sharded/pipelined trainers inherit it as
+        a per-replica upper bound, and summarize stays tolerant when the
+        event is absent. Purely advisory, so it must never break training.
+        Emitted once per Trainer — callers (cli/train.py length-bucket
+        loops) may invoke fit() repeatedly on the same step functions."""
+        if self.telemetry is None or getattr(self, "_cost_predicted", False):
+            return
+        self._cost_predicted = True
+        try:
+            from transformer_tpu.analysis.costs import train_step_costs
+
+            r = train_step_costs(self.model_cfg, self.train_cfg)
+        except Exception as e:  # tpa: disable=TPA006 — advisory-only: any config the cost model cannot trace (custom forwards, exotic objectives) must degrade to "no prediction", never to a failed training run
+            self.log_fn(f"cost-model prediction unavailable ({type(e).__name__}: {e})")
+            return
+        self.telemetry.registry.gauge(
+            "train_predicted_peak_bytes",
+            "jaxpr cost model: train-step peak live-buffer bytes",
+        ).set(r.peak_bytes)
+        self.telemetry.emit(
+            "train.predicted",
+            program="train_step",
+            peak_bytes=r.peak_bytes,
+            flops=r.flops,
+            bytes_moved=r.bytes_moved,
+            tokens_per_step=r.extras.get("tokens_per_step"),
         )
 
     def _record_epoch_telemetry(self, epoch: int, step: int) -> None:
