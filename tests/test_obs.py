@@ -67,6 +67,56 @@ def test_streaming_histogram_buckets_are_ascending_nonempty():
     assert sum(c for _, c in buckets) == h.count
 
 
+def test_streaming_histogram_counts_out_of_range_samples():
+    """Satellite audit: samples outside [lo, hi) clamp into the edge
+    buckets (historical behavior) but are now COUNTED and surfaced by
+    snapshot() — a mis-ranged histogram announces itself instead of
+    silently reporting clamp artifacts as tail quantiles."""
+    h = StreamingHistogram()
+    h.observe(0.5)
+    assert h.underflow == 0 and h.overflow == 0
+    assert "underflow" not in h.snapshot()  # in-range: schema unchanged
+    h.observe(1e-9, n=3)   # below lo=1e-6
+    h.observe(5e4)         # at/above hi=1e4
+    h.observe(2e5)
+    snap = h.snapshot()
+    assert snap["underflow"] == 3 and h.underflow == 3
+    assert snap["overflow"] == 2 and h.overflow == 2
+    assert snap["count"] == 6
+    # Exact side-stats still honest at the tails.
+    assert snap["min"] == 1e-9 and snap["max"] == 2e5
+    # Boundary semantics: lo is IN range, hi is not.
+    h2 = StreamingHistogram(lo=1e-3, hi=1.0)
+    h2.observe(1e-3)
+    h2.observe(1.0)
+    assert h2.underflow == 0 and h2.overflow == 1
+
+
+def test_streaming_histogram_error_bound_vs_sorted_reference():
+    """Satellite: pin the documented quantile error bound (sqrt(growth)-1
+    relative) against an exact sorted-reference quantile over a seeded
+    non-uniform stream — the bound must hold at every reported
+    percentile, not just on uniform data."""
+    import random
+
+    rng = random.Random(1234)
+    h = StreamingHistogram()
+    samples = []
+    for _ in range(5000):
+        # Log-uniform over ~7 decades of the in-range span: exercises many
+        # buckets, including sparse tails.
+        v = 10 ** rng.uniform(-5.5, 3.5)
+        samples.append(v)
+        h.observe(v)
+    samples.sort()
+    bound = math.sqrt(h.growth) - 1 + 1e-9
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 0.999):
+        exact = samples[min(len(samples) - 1, max(0, math.ceil(q * len(samples)) - 1))]
+        got = h.quantile(q)
+        assert abs(got - exact) / exact <= bound, (q, got, exact)
+    assert h.underflow == 0 and h.overflow == 0
+
+
 # --------------------------------------------------------------------------
 # StepTimer reuse (satellite: one quantile implementation, shared stream)
 
@@ -795,18 +845,26 @@ print(repr(flags.FLAGS.metrics_jsonl), flags.FLAGS.metrics_port,
 
 
 def test_obs_package_lints_clean():
-    """Satellite: `analysis rules` over obs/ is clean WITHOUT baseline help
-    (no new grandfathered findings; the package-wide tier-1 lint in
-    test_analysis.py covers it against the checked-in baseline too)."""
+    """Satellite: all three analysis lint families over obs/ are clean
+    WITHOUT baseline help (no new grandfathered findings; the package-wide
+    tier-1 lint in test_analysis.py covers it against the checked-in
+    baseline too). The trace/slo/merge modules ride the same bar."""
     from transformer_tpu.analysis import run_rules
+    from transformer_tpu.analysis.concurrency import run_concurrency
+    from transformer_tpu.analysis.sharding import run_sharding
 
     obs_dir = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "transformer_tpu", "obs",
     )
-    report = run_rules(paths=[obs_dir])
-    assert report.findings == [], "\n".join(str(f) for f in report.findings)
-    assert report.files_checked >= 6
+    for run in (run_rules, run_concurrency, run_sharding):
+        report = run(paths=[obs_dir])
+        assert report.findings == [], (
+            run.__name__ + ":\n"
+            + "\n".join(str(f) for f in report.findings)
+        )
+        assert report.files_checked >= 9
+    assert {"trace.py", "slo.py", "merge.py"} <= set(os.listdir(obs_dir))
 
 
 def test_obs_package_is_jax_free():

@@ -1,0 +1,326 @@
+"""SLO specs, burn-rate math, and the streaming engine (obs/slo.py).
+
+The burn-rate cases are HAND-COMPUTED: synthetic request streams with known
+good/bad counts inside each window, asserted against exact expected
+fractions — the satellite the ISSUE names.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from transformer_tpu.obs import EventLog, MetricsRegistry, Telemetry
+from transformer_tpu.obs.slo import (
+    DEFAULT_SLOS,
+    SLOEngine,
+    SLOSpec,
+    evaluate_slos,
+    parse_slo_spec,
+    span_sample,
+)
+
+# --------------------------------------------------------------------------
+# spec parsing
+
+
+def test_parse_slo_spec_grammar():
+    specs = parse_slo_spec(
+        "availability:objective=0.999,windows=60+600;"
+        "ttft_p95:threshold=0.5;"
+        "acceptance_rate:objective=0.6,name=floor"
+    )
+    by_name = {s.name: s for s in specs}
+    assert by_name["availability"].objective == 0.999
+    assert by_name["availability"].windows == (60.0, 600.0)
+    # Unset params inherit the default spec for that kind.
+    assert by_name["ttft_p95"].threshold_s == 0.5
+    assert by_name["ttft_p95"].objective == 0.95
+    assert by_name["floor"].kind == "acceptance_rate"
+    assert parse_slo_spec("none") == ()
+    assert parse_slo_spec("off") == ()
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense_kind",
+    "availability:objective=1.5",
+    "availability:objective",                 # not key=value
+    "availability:frobnicate=1",
+    "ttft_p95:objective=0.95,threshold=0",    # latency SLO needs threshold
+    "availability;availability",              # duplicate names
+    "availability:windows=0+60",
+])
+def test_parse_slo_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_slo_spec(bad)
+
+
+def test_default_slos_are_valid():
+    assert {s.kind for s in DEFAULT_SLOS} == {
+        "availability", "ttft_p95", "deadline_miss", "acceptance_rate"
+    }
+
+
+# --------------------------------------------------------------------------
+# per-span sampling
+
+
+def test_span_sample_per_kind():
+    avail = SLOSpec("a", "availability", 0.99)
+    ttft = SLOSpec("t", "ttft_p95", 0.95, threshold_s=0.5)
+    dl = SLOSpec("d", "deadline_miss", 0.99)
+    acc = SLOSpec("r", "acceptance_rate", 0.5)
+    ok = {"order": 1, "ttft_s": 0.2, "total_s": 1.0}
+    slow = {"order": 2, "ttft_s": 0.9, "total_s": 1.0}
+    err = {"order": 3, "error": "boom", "code": "internal"}
+    late = {"order": 4, "error": "deadline_ms elapsed", "code": "deadline"}
+    spec_span = {"order": 5, "ttft_s": 0.1, "drafted": 8, "draft_accepted": 6}
+    assert span_sample(avail, ok) == (0.0, 1.0)
+    assert span_sample(avail, err) == (1.0, 1.0)
+    assert span_sample(ttft, ok) == (0.0, 1.0)
+    assert span_sample(ttft, slow) == (1.0, 1.0)
+    assert span_sample(ttft, err) is None          # no first token: no sample
+    assert span_sample(dl, err) == (0.0, 1.0)
+    assert span_sample(dl, late) == (1.0, 1.0)
+    assert span_sample(acc, ok) is None            # never drafted
+    assert span_sample(acc, spec_span) == (2.0, 8.0)
+
+
+# --------------------------------------------------------------------------
+# hand-computed burn rates
+
+
+def _req(ts, **fields):
+    return {"kind": "serve.request", "ts": ts, **fields}
+
+
+def test_burn_rates_hand_computed_windows():
+    """Stream: 20 requests in the last 60s (2 errors), another 30 requests
+    60-600s ago (1 error). availability objective 0.99 (budget 0.01):
+
+    - 60s window:  bad 2/20  = 0.10 -> burn 10.0
+    - 600s window: bad 3/50  = 0.06 -> burn 6.0
+    """
+    now = 1_000_000.0
+    events = []
+    for i in range(20):
+        events.append(_req(now - 1 - i * 2.5, order=i,
+                           **({"error": "x", "code": "internal"} if i < 2
+                              else {"ttft_s": 0.1})))
+    for i in range(30):
+        events.append(_req(now - 61 - i * 17, order=100 + i,
+                           **({"error": "x", "code": "internal"} if i < 1
+                              else {"ttft_s": 0.1})))
+    spec = SLOSpec("availability", "availability", 0.99, windows=(60.0, 600.0))
+    report = evaluate_slos(events, [spec], now=now)
+    w = report["slos"]["availability"]["windows"]
+    assert w["60s"]["total"] == 20 and w["60s"]["bad"] == 2
+    assert w["60s"]["bad_fraction"] == 0.1
+    assert w["60s"]["burn_rate"] == 10.0
+    assert w["600s"]["total"] == 50 and w["600s"]["bad"] == 3
+    assert w["600s"]["bad_fraction"] == 0.06
+    assert w["600s"]["burn_rate"] == 6.0
+    # Both windows over 1.0 -> breached (the multi-window rule).
+    assert report["slos"]["availability"]["breached"] is True
+
+
+def test_burn_requires_every_window_hot():
+    """4 errors burst 90s ago: the 600s window burns, the 60s window is
+    clean — NOT a breach (the fast window proves it stopped)."""
+    now = 1_000_000.0
+    events = [_req(now - 90 - i, order=i, error="x", code="internal")
+              for i in range(4)]
+    events += [_req(now - 5 - i, order=10 + i, ttft_s=0.1) for i in range(6)]
+    spec = SLOSpec("availability", "availability", 0.9, windows=(60.0, 600.0))
+    report = evaluate_slos(events, [spec], now=now)
+    w = report["slos"]["availability"]["windows"]
+    assert w["60s"]["burn_rate"] == 0.0
+    assert w["600s"]["burn_rate"] == 4.0  # 4/10 bad over budget 0.1
+    assert report["slos"]["availability"]["breached"] is False
+
+
+def test_ttft_and_acceptance_weighted_math():
+    now = 500.0
+    events = [
+        _req(now - 10, order=0, ttft_s=0.2, drafted=10, draft_accepted=9),
+        _req(now - 20, order=1, ttft_s=2.0, drafted=30, draft_accepted=15),
+        _req(now - 30, order=2, ttft_s=0.1),
+        _req(now - 40, order=3, error="x", code="internal"),  # excluded: no ttft
+    ]
+    ttft = SLOSpec("ttft", "ttft_p95", 0.95, threshold_s=1.0, windows=(100.0,))
+    acc = SLOSpec("acc", "acceptance_rate", 0.5, windows=(100.0,))
+    report = evaluate_slos(events, [ttft, acc], now=now)
+    wt = report["slos"]["ttft"]["windows"]["100s"]
+    assert wt["total"] == 3 and wt["bad"] == 1       # one request over 1s
+    assert wt["burn_rate"] == round((1 / 3) / 0.05, 4)
+    wa = report["slos"]["acc"]["windows"]["100s"]
+    # Token-weighted: 40 drafted, 16 rejected -> 0.4 bad over budget 0.5.
+    assert wa["total"] == 40 and wa["bad"] == 16
+    assert wa["burn_rate"] == 0.8
+
+
+def test_no_samples_reports_none_not_breach():
+    spec = SLOSpec("availability", "availability", 0.99)
+    report = evaluate_slos([], [spec], now=100.0)
+    w = report["slos"]["availability"]["windows"]
+    assert all(x["burn_rate"] is None for x in w.values())
+    assert report["slos"]["availability"]["breached"] is False
+
+
+# --------------------------------------------------------------------------
+# the streaming engine
+
+
+def test_engine_gauges_and_breach_transition_events():
+    clock = [1000.0]
+    buf = io.StringIO()
+    log = EventLog(buf)
+    reg = MetricsRegistry()
+    spec = SLOSpec("availability", "availability", 0.9, windows=(60.0, 600.0))
+    eng = SLOEngine(
+        [spec], registry=reg, emit=log.emit, interval=0.0,
+        clock=lambda: clock[0],
+    )
+    for i in range(8):
+        eng.record({"order": i, "ttft_s": 0.1})
+    eng.evaluate()
+    assert reg.gauge("serve_slo_burn_availability").value == 0.0
+    # Now a fault storm: 8 errors -> bad fraction 0.5, burn 5.0 in BOTH
+    # windows -> one breach-start event.
+    for i in range(8):
+        eng.record({"order": 10 + i, "error": "x", "code": "internal"})
+    eng.evaluate()
+    assert reg.gauge("serve_slo_burn_availability").value == 5.0
+    eng.evaluate()  # still breached: no second event
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    burns = [e for e in events if e["kind"] == "slo.burn"]
+    assert len(burns) == 1 and burns[0]["breached"] is True
+    assert burns[0]["name"] == "availability"
+    assert burns[0]["windows"]["60s"] == 5.0
+    # 70s later the fast window is clean; the breach ENDS -> one more event.
+    clock[0] += 70.0
+    for i in range(4):
+        eng.record({"order": 20 + i, "ttft_s": 0.1})
+    eng.evaluate()
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    burns = [e for e in events if e["kind"] == "slo.burn"]
+    assert len(burns) == 2 and burns[1]["breached"] is False
+
+
+def test_engine_prunes_beyond_longest_window():
+    clock = [0.0]
+    spec = SLOSpec("availability", "availability", 0.9, windows=(10.0,))
+    eng = SLOEngine([spec], interval=0.0, clock=lambda: clock[0])
+    for i in range(100):
+        eng.record({"order": i})
+    clock[0] = 1000.0
+    eng.evaluate()
+    assert len(eng._samples["availability"]) == 0  # memory stays bounded
+
+
+def test_engine_maybe_evaluate_honors_interval():
+    clock = [0.0]
+    eng = SLOEngine(
+        [SLOSpec("availability", "availability", 0.9)],
+        interval=5.0, clock=lambda: clock[0],
+    )
+    assert eng.maybe_evaluate() is not None   # first call runs
+    assert eng.maybe_evaluate() is None       # within the interval
+    clock[0] += 6.0
+    assert eng.maybe_evaluate() is not None
+    assert eng.maybe_evaluate(force=True) is not None
+
+
+# --------------------------------------------------------------------------
+# scheduler integration (CPU tiny model)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.models import transformer_init
+
+    tok = SubwordTokenizer.build_from_corpus(
+        ["ab cd ef gh ij kl mn"] * 3, target_vocab_size=300
+    )
+    cfg = ModelConfig(
+        num_layers=1, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=tok.model_vocab_size,
+        target_vocab_size=tok.model_vocab_size,
+        max_position=32, decoder_only=True, tie_output=True,
+        dtype="float32", dropout_rate=0.0,
+    )
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg, tok
+
+
+def _scheduler(lm, telemetry, **kw):
+    from transformer_tpu.serve import ContinuousScheduler
+
+    params, cfg, tok = lm
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_total", 32)
+    kw.setdefault("default_max_new", 4)
+    return ContinuousScheduler(params, cfg, tok, telemetry=telemetry, **kw)
+
+
+def test_scheduler_slo_gauges_and_byte_identity(lm):
+    _, cfg, _ = lm
+    reqs = [
+        {"prompt": "ab cd ef", "max_new": 3},
+        {"prompt": "ab " * cfg.max_position, "max_new": 2},  # over-length
+        {"prompt": "kl", "max_new": 2},
+    ]
+    plain = _scheduler(lm, None).run(reqs)
+    buf = io.StringIO()
+    tel = Telemetry(events=EventLog(buf), interval=0.0)
+    slo_out = _scheduler(
+        lm, tel, slos="availability:objective=0.9,windows=60+600"
+    ).run(reqs)
+    assert plain == slo_out  # SLO accounting is invisible in answers
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    snap = [e for e in events if e["kind"] == "metrics.snapshot"][-1]["metrics"]
+    # 1 error / 3 requests = 0.333 bad over budget 0.1 -> burn ~3.33 in
+    # both windows, exported and breached.
+    assert snap["serve_slo_burn_availability"] == pytest.approx(3.3333, abs=0.01)
+    burns = [e for e in events if e["kind"] == "slo.burn"]
+    assert len(burns) == 1 and burns[0]["breached"] is True
+    # summarize surfaces the transition.
+    from transformer_tpu.obs.__main__ import summarize_events
+
+    report = summarize_events(events)
+    assert report["slo_transitions"]["availability"]["breaches"] == 1
+
+
+def test_scheduler_slos_off_without_spec(lm):
+    tel = Telemetry(interval=0.0)
+    s = _scheduler(lm, tel)  # no slos=
+    assert s._slo is None
+    s2 = _scheduler(lm, tel, slos="none")
+    assert s2._slo is None
+
+
+def test_slo_cli_on_real_log(lm, tmp_path, capsys):
+    from transformer_tpu.obs.__main__ import main
+
+    jsonl = str(tmp_path / "serve.jsonl")
+    tel = Telemetry(events=EventLog(jsonl), interval=0.0)
+    _scheduler(lm, tel, slos=DEFAULT_SLOS).run([
+        {"prompt": "ab cd", "max_new": 2},
+        {"prompt": "ef gh", "max_new": 2},
+    ])
+    tel.close()
+    assert main(["slo", jsonl, "--format", "json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 2
+    assert report["slos"]["availability"]["breached"] is False
+    avail = report["slos"]["availability"]["windows"]
+    assert avail["300s"]["total"] == 2 and avail["300s"]["bad"] == 0
+    # --last applies to the slo report too (the satellite).
+    assert main(["slo", jsonl, "--last", "1h"]) == 0
+    assert main(["slo", jsonl, "--slo_spec", "bogus"]) == 2

@@ -39,14 +39,15 @@ Contracts:
   max_new) int32 ids.
 - **train_step_dtypes** — one abstract optimizer step preserves every
   parameter's dtype (param_dtype, not compute dtype) and advances ``step``.
-- **telemetry_inert** — the obs instrumentation wrapper (``obs.telemetry
-  .timed_call``, which the Trainer installs around its jitted step
-  dispatches when telemetry is on) must produce a jaxpr BYTE-IDENTICAL to
-  the uninstrumented twin's for both the train step and the serving pool
-  step (the pool step is traced through the same wrapper here; the
-  scheduler's own recording is inline host code at step boundaries):
-  telemetry records host-side scalars and can never leak an operation into
-  traced code.
+- **telemetry_inert** — the obs instrumentation wrappers
+  (``obs.telemetry.timed_call`` composed with ``obs.trace.traced_call`` —
+  exactly what the Trainer installs around its jitted step dispatches when
+  telemetry/tracing are on) must produce a jaxpr BYTE-IDENTICAL to the
+  uninstrumented twin's for the train step AND the serving pool step, slot
+  prefill, and speculative verify programs (tracing-on vs. tracing-off;
+  the scheduler's own span recording is inline host code at step
+  boundaries): telemetry records host-side scalars and can never leak an
+  operation into traced code.
 - **fault_plane_inert** — an ARMED fault plane (``serve.resilience``)
   must leave the serving hot paths' jaxprs byte-identical to the
   disarmed twin's: injection points live in host code between dispatches
@@ -469,23 +470,33 @@ def check_train_step_dtypes(cfg: ModelConfig) -> str:
 def check_telemetry_inert(cfg: ModelConfig) -> str:
     """Instrumented and uninstrumented step functions must trace to
     byte-identical jaxprs. The instrumented twin is built with the real
-    wrapper the telemetry-enabled Trainer installs around its step
-    dispatches (``obs.telemetry.timed_call`` feeding a live registry
-    histogram + counter); the serving pool step is traced through the same
-    wrapper. Any future 'improvement' that lets a recorded value flow back
-    into the computation — or adds so much as a ``convert_element_type`` to
-    the trace — fails here, rounds before a byte-identity serving test
-    would catch it on hardware. (The scheduler's own span recording is
-    inline host code at step boundaries; its inertness is pinned by the
-    byte-identity + zero-recompile tests in tests/test_obs.py.)"""
+    wrappers the telemetry-enabled Trainer installs around its step
+    dispatches — ``obs.telemetry.timed_call`` feeding a live registry
+    histogram + counter, COMPOSED with ``obs.trace.traced_call`` opening a
+    real span on a live tracer (the ``--trace`` stack, spans emitted to a
+    real in-memory EventLog); the serving pool step, slot prefill, and
+    speculative verify programs are traced through the same wrappers. Any
+    future 'improvement' that lets a recorded value flow back into the
+    computation — or adds so much as a ``convert_element_type`` to the
+    trace — fails here, rounds before a byte-identity serving test would
+    catch it on hardware. (The scheduler's own span recording is inline
+    host code at step boundaries; its inertness is pinned by the
+    byte-identity + zero-recompile tests in tests/test_obs.py and
+    tests/test_trace.py.)"""
+    import io
+
     from transformer_tpu.obs import MetricsRegistry
+    from transformer_tpu.obs.events import EventLog
     from transformer_tpu.obs.telemetry import timed_call
+    from transformer_tpu.obs.trace import Tracer, traced_call
     from transformer_tpu.train.state import TrainState, make_optimizer
     from transformer_tpu.train.trainer import make_train_step
 
     import re
 
     reg = MetricsRegistry()
+    span_sink = io.StringIO()
+    tracer = Tracer(EventLog(span_sink).emit)
 
     def canon(jaxpr) -> str:
         # custom_jvp equations print closure thunks with their memory
@@ -495,9 +506,12 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
         return re.sub(r"0x[0-9a-f]+", "0x", str(jaxpr))
 
     def twins(fn):
+        # The exact production composition: traced_call outermost around
+        # timed_call (trainer._wrap_steps_for_dispatch_timing order).
         wrapped = timed_call(
             fn, reg.histogram("contract_seconds"), reg.counter("contract_total")
         )
+        wrapped = traced_call(wrapped, tracer, "contract.step")
         return fn, wrapped
 
     checked = []
@@ -526,30 +540,66 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
     assert a == b, "timed_call changed the TRAIN step jaxpr — telemetry leaked into traced code"
     checked.append("train_step")
 
-    # -- serving pool step (decoder-only exports) ---------------------------
+    # -- serving pool step / prefill / verify (decoder-only exports) --------
     if cfg.decoder_only:
         from transformer_tpu.serve.scheduler import (
             _pool_step,
+            _pool_verify,
+            _slot_prefill,
             abstract_pool_caches,
         )
 
         slots, total = 2, 16
         pool = abstract_pool_caches(cfg, slots, total)
         toks = jax.ShapeDtypeStruct((slots,), np.int32)
-        raw = _pool_step.__wrapped__
-        plain, wrapped = twins(lambda p, c, t: raw(p, c, t, cfg))
+        step_raw = _pool_step.__wrapped__
+        plain, wrapped = twins(lambda p, c, t: step_raw(p, c, t, cfg))
         a = canon(jax.make_jaxpr(plain)(params, pool, toks))
         b = canon(jax.make_jaxpr(wrapped)(params, pool, toks))
         assert a == b, (
-            "timed_call changed the POOL step jaxpr — telemetry leaked into "
-            "traced serving code"
+            "telemetry wrappers changed the POOL step jaxpr — telemetry "
+            "leaked into traced serving code"
         )
         checked.append("pool_step")
+        prefill_raw = _slot_prefill.__wrapped__
+        prompt = jax.ShapeDtypeStruct((1, 8), np.int32)
+        scalar = jax.ShapeDtypeStruct((), np.int32)
+        plain, wrapped = twins(
+            lambda p, c, s, pr, st: prefill_raw(p, c, s, pr, st, cfg, 0)
+        )
+        a = canon(jax.make_jaxpr(plain)(params, pool, scalar, prompt, scalar))
+        b = canon(jax.make_jaxpr(wrapped)(params, pool, scalar, prompt, scalar))
+        assert a == b, (
+            "telemetry wrappers changed the SLOT prefill jaxpr — telemetry "
+            "leaked into traced serving code"
+        )
+        checked.append("slot_prefill")
+        if not cfg.attention_window:
+            # Verify rides the same S_q>1 cache-write path rollback needs;
+            # rolling-window configs refuse speculation, so the program
+            # does not exist for them.
+            verify_raw = _pool_verify.__wrapped__
+            rows = jax.ShapeDtypeStruct((slots, 3), np.int32)
+            plain, wrapped = twins(lambda p, c, t: verify_raw(p, c, t, cfg))
+            a = canon(jax.make_jaxpr(plain)(params, pool, rows))
+            b = canon(jax.make_jaxpr(wrapped)(params, pool, rows))
+            assert a == b, (
+                "telemetry wrappers changed the VERIFY jaxpr — telemetry "
+                "leaked into traced serving code"
+            )
+            checked.append("pool_verify")
     assert reg.histogram("contract_seconds").hist.count >= len(checked), (
         "the instrumented twin never recorded — the contract exercised a "
         "dead wrapper"
     )
-    return f"jaxpr-identical twins: {', '.join(checked)}"
+    assert tracer.stats["ended"] >= len(checked) and tracer.open_count == 0, (
+        "the traced twin never opened/closed a span — the tracing side of "
+        "the contract is vacuous"
+    )
+    assert "trace.span" in span_sink.getvalue(), (
+        "the tracer's spans never reached the event log"
+    )
+    return f"jaxpr-identical twins (timed+traced): {', '.join(checked)}"
 
 
 def check_fault_plane_inert(cfg: ModelConfig) -> str:

@@ -76,6 +76,13 @@ def define_metrics_flags() -> None:
         "metrics_interval", 10.0,
         "seconds between periodic metric-snapshot flushes (prom file + "
         "metrics.snapshot events)")
+    flags.DEFINE_boolean(
+        "trace", False,
+        "record hierarchical trace.span events (request-scoped distributed "
+        "tracing, docs/OBSERVABILITY.md) into --metrics_jsonl; export with "
+        "`python -m transformer_tpu.obs trace <file> --out trace.json` and "
+        "load in chrome://tracing / Perfetto. Answers and compiled programs "
+        "are unaffected (contract-checked)")
 
 
 def define_flags() -> None:
@@ -385,10 +392,18 @@ def flags_to_telemetry():
             FLAGS.metrics_jsonl,
             breaker=CircuitBreaker("event_sink", threshold=3, cooldown_s=30.0),
         )
+    if FLAGS.trace and events is None:
+        # A tracer without an event sink would pay full span bookkeeping
+        # and silently drop every trace.span — tell the operator instead.
+        logging.warning(
+            "--trace needs --metrics_jsonl to record trace.span events; "
+            "tracing disabled for this run"
+        )
     telemetry = Telemetry(
         events=events,
         prom_path=f"{FLAGS.metrics_jsonl}.prom" if FLAGS.metrics_jsonl else None,
         interval=FLAGS.metrics_interval,
+        trace=FLAGS.trace and events is not None,
     )
     if FLAGS.metrics_port:
         port = telemetry.start_prometheus_server(FLAGS.metrics_port)

@@ -153,6 +153,14 @@ def define_serve_flags() -> None:
         "deterministic fault injection for chaos drills (docs/ROBUSTNESS.md "
         "grammar), e.g. 'serve.prefill:p=0.25,seed=7;obs.emit:at=5'. "
         "'' = disarmed (zero overhead)")
+    flags.DEFINE_string(
+        "slo_spec", "",
+        "SLO objectives evaluated as multi-window burn rates over the "
+        "answer stream (docs/OBSERVABILITY.md grammar), e.g. "
+        "'availability:objective=0.999;ttft_p95:threshold=0.5'. '' = the "
+        "default objectives when telemetry is on; 'none' = off. Surfaced "
+        "as serve_slo_burn_* gauges + slo.burn events; report offline with "
+        "`python -m transformer_tpu.obs slo <jsonl>`")
 
 
 def _parse_line(line: str, model_cfg) -> dict:
@@ -413,6 +421,14 @@ def main(argv) -> None:
         resilience.install(resilience.FaultPlane.parse(FLAGS.fault_spec))
         logging.info("fault plane armed: %s", FLAGS.fault_spec)
     telemetry = flags_to_telemetry()
+    if FLAGS.slo_spec and FLAGS.slo_spec.lower() not in ("none", "off") \
+            and telemetry is None:
+        # The engine's whole output is gauges + slo.burn events: without a
+        # telemetry sink an explicit spec would silently enforce nothing.
+        logging.warning(
+            "--slo_spec needs --metrics_jsonl (or --metrics_port) to "
+            "surface burn rates; SLO evaluation disabled for this run"
+        )
 
     from transformer_tpu.cli.translate import load_export
     from transformer_tpu.data.tokenizer import SubwordTokenizer
@@ -447,6 +463,7 @@ def main(argv) -> None:
     q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_batch) * 8)
     threading.Thread(target=_stdin_reader, args=(q,), daemon=True).start()
     if continuous:
+        from transformer_tpu.obs.slo import DEFAULT_SLOS
         from transformer_tpu.serve import (
             ContinuousScheduler,
             PrefixCache,
@@ -502,6 +519,10 @@ def main(argv) -> None:
             admission_retries=FLAGS.admission_retries,
             breaker_threshold=FLAGS.breaker_threshold,
             breaker_cooldown_s=FLAGS.breaker_cooldown,
+            # '' = the default objective set (only consulted when telemetry
+            # is on — the engine's whole output is gauges + events);
+            # 'none' parses to an empty tuple and disables it.
+            slos=FLAGS.slo_spec or (DEFAULT_SLOS if telemetry else None),
         )
         serve_continuous(q, sched, model_cfg, telemetry=telemetry)
         if telemetry is not None:

@@ -14,6 +14,7 @@ cheap to uphold. See docs/OBSERVABILITY.md.
 """
 
 from transformer_tpu.obs.events import EventLog, read_events
+from transformer_tpu.obs.merge import filter_events, merge_events
 from transformer_tpu.obs.quantiles import StreamingHistogram
 from transformer_tpu.obs.registry import (
     Counter,
@@ -21,21 +22,45 @@ from transformer_tpu.obs.registry import (
     Histogram,
     MetricsRegistry,
 )
+from transformer_tpu.obs.slo import (
+    DEFAULT_SLOS,
+    SLOEngine,
+    SLOSpec,
+    evaluate_slos,
+    parse_slo_spec,
+)
 from transformer_tpu.obs.telemetry import (
     Telemetry,
     device_memory_stats,
     timed_call,
 )
+from transformer_tpu.obs.trace import (
+    SpanContext,
+    Tracer,
+    chrome_trace,
+    traced_call,
+)
 
 __all__ = [
     "Counter",
+    "DEFAULT_SLOS",
     "EventLog",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "SLOEngine",
+    "SLOSpec",
+    "SpanContext",
     "StreamingHistogram",
     "Telemetry",
+    "Tracer",
+    "chrome_trace",
     "device_memory_stats",
+    "evaluate_slos",
+    "filter_events",
+    "merge_events",
+    "parse_slo_spec",
     "read_events",
     "timed_call",
+    "traced_call",
 ]

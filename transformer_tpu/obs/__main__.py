@@ -1,11 +1,22 @@
-"""``python -m transformer_tpu.obs summarize <jsonl>`` — run report.
+"""``python -m transformer_tpu.obs <summarize|trace|slo>`` — telemetry CLI.
 
-Aggregates a structured event log (docs/OBSERVABILITY.md schema) into the
-operator-facing numbers the ISSUE names: tokens/s, step p50/p95, slot
-utilization, and the per-request latency breakdown (queue → prefill →
-first-token → total). Works on logs from a train run, a serve session, or a
-file that interleaves both (the aggregator keys on ``kind``). CPU-only,
-jax-free — safe to run on a laptop against a log scp'd off a TPU host.
+- ``summarize`` aggregates a structured event log (docs/OBSERVABILITY.md
+  schema) into the operator-facing numbers: tokens/s, step p50/p95, slot
+  utilization, and the per-request latency breakdown (queue → prefill →
+  first-token → total). Works on logs from a train run, a serve session, or
+  a file that interleaves both (the aggregator keys on ``kind``).
+- ``trace`` exports ``trace.span`` events (the ``--trace`` flag's output)
+  to Chrome trace-event JSON — load the file in chrome://tracing or
+  ui.perfetto.dev; one lane per serve slot plus scheduler/intake/train.
+- ``slo`` evaluates declarative SLOs (``obs/slo.py``) as multi-window burn
+  rates over the same log.
+
+All three accept MULTIPLE jsonl files (``--merge``): events are tagged with
+their source and clock-aligned via per-file skew estimation
+(``obs/merge.py``) — the cross-replica aggregation the scale-out roadmap
+item requires. ``--since TS`` / ``--last N{s,m,h}`` slice long soak logs.
+CPU-only, jax-free — safe to run on a laptop against logs scp'd off TPU
+hosts.
 """
 
 from __future__ import annotations
@@ -14,7 +25,7 @@ import argparse
 import json
 import sys
 
-from transformer_tpu.obs.events import read_events
+from transformer_tpu.obs.merge import filter_events, merge_events, parse_duration
 from transformer_tpu.obs.quantiles import StreamingHistogram
 
 
@@ -264,6 +275,24 @@ def summarize_events(events: list[dict]) -> dict:
             counts[e["kind"]] = counts.get(e["kind"], 0) + 1
         report["bench"] = counts
 
+    # ---- tracing (span volume only; `obs trace` renders the timeline) ----
+    spans = [e for e in events if e.get("kind") == "trace.span"]
+    if spans:
+        traces = {e.get("trace") for e in spans}
+        report["tracing"] = {"spans": len(spans), "traces": len(traces)}
+
+    # ---- SLO breach transitions ------------------------------------------
+    burns = [e for e in events if e.get("kind") == "slo.burn"]
+    if burns:
+        slo: dict[str, dict] = {}
+        for e in burns:
+            name = str(e.get("name"))
+            entry = slo.setdefault(name, {"breaches": 0})
+            if e.get("breached"):
+                entry["breaches"] += 1
+            entry["final_breached"] = bool(e.get("breached"))
+        report["slo_transitions"] = slo
+
     return report
 
 
@@ -388,9 +417,73 @@ def render_text(report: dict) -> str:
             "bench: " + ", ".join(f"{k.split('.', 1)[1]} x{v}"
                                   for k, v in sorted(bench.items()))
         )
+    tracing = report.get("tracing")
+    if tracing:
+        lines.append(
+            f"tracing: {tracing['spans']} spans across {tracing['traces']} "
+            "traces (`obs trace` exports the timeline)"
+        )
+    slo = report.get("slo_transitions")
+    if slo:
+        parts = [
+            f"{name} {s['breaches']} breach(es)"
+            + (" [still breached]" if s.get("final_breached") else "")
+            for name, s in sorted(slo.items())
+        ]
+        lines.append("slo: " + "; ".join(parts))
+    sources = report.get("sources")
+    if sources:
+        parts = [
+            f"{name} ({s['events']} events"
+            + (f", skew {s['skew_s']:+g}s" if s.get("skew_s") else "")
+            + ")"
+            for name, s in sorted(sources.items())
+        ]
+        lines.append("sources: " + "; ".join(parts))
     if len(lines) == 1:
         lines.append("no serve/train/bench telemetry kinds found")
     return "\n".join(lines)
+
+
+def _add_common_args(p) -> None:
+    p.add_argument(
+        "jsonl", nargs="+",
+        help="event log(s) written via --metrics_jsonl; pass several to "
+        "aggregate across processes/replicas",
+    )
+    p.add_argument(
+        "--merge", action="store_true",
+        help="treat inputs as a multi-source merge (implied when more than "
+        "one file is given): tag events with their source, align clocks "
+        "via per-file skew estimation, and report the per-source table "
+        "(with one file, forces the source-tagged report)",
+    )
+    p.add_argument(
+        "--no-align", action="store_true",
+        help="merge without clock-skew alignment (raw timestamps)",
+    )
+    p.add_argument(
+        "--since", type=float, default=None, metavar="TS",
+        help="drop events before this unix timestamp (seconds)",
+    )
+    p.add_argument(
+        "--last", type=str, default=None, metavar="N{s,m,h}",
+        help="keep only the trailing window of the log, e.g. 90s / 5m / 2h "
+        "(measured back from the newest event)",
+    )
+
+
+def _load(args) -> "tuple[list, dict]":
+    """Common input path: read one file or merge several, then apply the
+    time-window slice. Returns (events, merge_report)."""
+    events, info = merge_events(args.jsonl, align=not args.no_align)
+    if args.last is not None:
+        events = filter_events(events, last=parse_duration(args.last))
+    if args.since is not None:
+        events = filter_events(events, since=args.since)
+    # The per-source table rides along whenever this IS a merge — more
+    # than one input, or --merge forcing the tagged report for one file.
+    return events, info if (len(args.jsonl) > 1 or args.merge) else {}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -400,24 +493,99 @@ def main(argv: list[str] | None = None) -> int:
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
     p_sum = sub.add_parser(
-        "summarize", help="render a run report from a JSONL event log"
+        "summarize", help="render a run report from JSONL event log(s)"
     )
-    p_sum.add_argument("jsonl", help="event log written via --metrics_jsonl")
+    _add_common_args(p_sum)
     p_sum.add_argument(
         "--format", choices=("text", "json"), default="text",
         help="output format (json is diff-able across runs)",
     )
+    p_trace = sub.add_parser(
+        "trace",
+        help="export trace.span events to Chrome trace-event JSON "
+        "(chrome://tracing / ui.perfetto.dev)",
+    )
+    _add_common_args(p_trace)
+    p_trace.add_argument(
+        "--out", default="trace.json",
+        help="output path for the trace-event JSON (default: trace.json)",
+    )
+    p_slo = sub.add_parser(
+        "slo", help="evaluate SLO burn rates over the event log(s)"
+    )
+    _add_common_args(p_slo)
+    p_slo.add_argument(
+        "--slo_spec", default="",
+        help="SLO spec string (obs/slo.py grammar, same as the serve "
+        "flag); '' = the default objectives",
+    )
+    p_slo.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
     args = parser.parse_args(argv)
     try:
-        events = read_events(args.jsonl)
+        events, info = _load(args)
     except OSError as e:
-        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        print(f"cannot read {', '.join(args.jsonl)}: {e}", file=sys.stderr)
         return 2
-    report = summarize_events(events)
+    except ValueError as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return 2
+
+    if args.cmd == "summarize":
+        report = summarize_events(events)
+        report.update(info)
+        if args.format == "json":
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print(render_text(report))
+        return 0
+
+    if args.cmd == "trace":
+        from transformer_tpu.obs.trace import chrome_trace
+
+        doc = chrome_trace(events)
+        if info.get("sources"):
+            doc["otherData"]["skews"] = {
+                name: s["skew_s"] for name, s in info["sources"].items()
+            }
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        n = doc["otherData"]["spans"]
+        if not n:
+            print(
+                f"warning: no trace.span events found (run with --trace?); "
+                f"wrote an empty trace to {args.out}",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"{n} spans from {len(doc['otherData']['sources'])} "
+                f"source(s) -> {args.out} (load in chrome://tracing or "
+                "ui.perfetto.dev)"
+            )
+        return 0
+
+    # slo
+    from transformer_tpu.obs.slo import (
+        DEFAULT_SLOS,
+        evaluate_slos,
+        parse_slo_spec,
+        render_slo_text,
+    )
+
+    try:
+        specs = parse_slo_spec(args.slo_spec) if args.slo_spec else DEFAULT_SLOS
+    except ValueError as e:
+        print(f"bad --slo_spec: {e}", file=sys.stderr)
+        return 2
+    report = evaluate_slos(events, specs)
+    if info:
+        report.update(info)
     if args.format == "json":
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
-        print(render_text(report))
+        print(render_slo_text(report))
     return 0
 
 

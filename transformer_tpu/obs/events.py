@@ -10,6 +10,14 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
   boundary): steps, tokens, throughput, loss/accuracy/grad-norm.
 - ``train.memory`` / ``train.compile`` — device memory stats and jit
   compile-cache accounting at epoch boundaries.
+- ``trace.span`` — one per CLOSED tracing span (``obs/trace.py``):
+  ``trace``/``span``/``parent`` lineage, ``name``, ``lane``, start ``t0``
+  and ``dur_s``. Export with ``python -m transformer_tpu.obs trace``.
+- ``slo.burn`` — one per SLO breach-state transition (``obs/slo.py``):
+  ``name``, ``breached``, per-window burn rates.
+- ``serve.retry`` — one per transient-admission retry: ``order``,
+  ``attempt``, ``backoff_ms``, the fault, and the victim's ``trace`` id
+  when tracing is on.
 - ``metrics.snapshot`` — periodic full registry dump (histograms as
   count/sum/min/max/p50/p95/p99).
 - ``bench.relay_probe`` / ``bench.fallback_row`` / ``bench.attempt`` —

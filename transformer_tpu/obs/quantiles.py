@@ -16,8 +16,11 @@ class rather than keeping their own percentile code. Design constraints:
   reporting, and the same shape Prometheus client libraries use.
 
 Values below ``lo`` clamp into the first bucket, values at or above ``hi``
-into the last; exact ``min``/``max``/``sum``/``count`` are tracked on the
-side so summaries stay honest at the tails.
+into the last — AND are counted (``underflow`` / ``overflow``, surfaced by
+``snapshot()``), so a mis-ranged histogram announces itself instead of
+silently reporting clamped tails as real quantiles. Exact
+``min``/``max``/``sum``/``count`` are tracked on the side so summaries stay
+honest at the tails.
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ class StreamingHistogram:
     __slots__ = (
         "lo", "hi", "growth", "_log_lo", "_log_growth", "_counts",
         "count", "total", "sum_squares", "min", "max",
+        "underflow", "overflow",
     )
 
     def __init__(
@@ -55,6 +59,12 @@ class StreamingHistogram:
         self.sum_squares = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # Samples outside [lo, hi): clamped into the edge buckets (above),
+        # but COUNTED — a nonzero tally means the configured range is wrong
+        # for this stream and the reported tail quantiles are clamp
+        # artifacts, not measurements.
+        self.underflow = 0
+        self.overflow = 0
 
     def observe(self, value: float, n: int = 1) -> None:
         """Record ``value`` ``n`` times (``n > 1`` attributes one measured
@@ -72,6 +82,10 @@ class StreamingHistogram:
             self.min = value
         if value > self.max:
             self.max = value
+        if value < self.lo:
+            self.underflow += n
+        elif value >= self.hi:
+            self.overflow += n
         self._counts[self._index(value)] += n
 
     def _index(self, value: float) -> int:
@@ -117,7 +131,7 @@ class StreamingHistogram:
         """JSON-able summary (the form the event log and summarize CLI use)."""
         if not self.count:
             return {"count": 0}
-        return {
+        out = {
             "count": self.count,
             "sum": self.total,
             "mean": self.mean,
@@ -125,3 +139,11 @@ class StreamingHistogram:
             "max": self.max,
             **self.percentiles(),
         }
+        # Only when nonzero: the common in-range case stays schema-stable
+        # for every existing snapshot consumer, and a present key IS the
+        # warning.
+        if self.underflow:
+            out["underflow"] = self.underflow
+        if self.overflow:
+            out["overflow"] = self.overflow
+        return out

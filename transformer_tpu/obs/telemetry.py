@@ -54,7 +54,16 @@ def timed_call(
 
 
 class Telemetry:
-    """Registry + event log + periodic sinks, as one pass-around handle."""
+    """Registry + event log + periodic sinks, as one pass-around handle.
+
+    ``trace=True`` additionally carries a :class:`~transformer_tpu.obs.
+    trace.Tracer` bound to this bundle's event emit — the scheduler and
+    trainer consult ``telemetry.tracer`` and record hierarchical
+    ``trace.span`` events when it is set (docs/OBSERVABILITY.md tracing
+    section). Off by default: spans multiply event volume per request, so
+    tracing is an explicit opt-in (``--trace``), while staying answer- and
+    jaxpr-inert whenever it IS on (contract-checked).
+    """
 
     def __init__(
         self,
@@ -62,6 +71,7 @@ class Telemetry:
         events: EventLog | None = None,
         prom_path: str | None = None,
         interval: float = 10.0,
+        trace: bool = False,
     ) -> None:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.events = events
@@ -69,6 +79,11 @@ class Telemetry:
         self.interval = max(float(interval), 0.0)
         self._last_flush = 0.0
         self._server = None
+        self.tracer = None
+        if trace:
+            from transformer_tpu.obs.trace import Tracer
+
+            self.tracer = Tracer(self.emit)
 
     # ---- events -----------------------------------------------------------
 

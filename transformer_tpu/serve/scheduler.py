@@ -72,10 +72,32 @@ with state exported as obs gauges + ``serve.breaker`` events — the chaos
 suite (tests/test_resilience.py) pins that fault storms lose no request,
 slot, or prefix pin, and that greedy answers return byte-identical once
 the breakers close, at zero steady-state recompiles.
+
+Tracing (``telemetry.tracer`` set — the ``--trace`` flag): every request
+becomes a span tree (``serve.request`` root; ``serve.queue`` /
+``serve.admit`` / ``serve.prefill`` / ``serve.decode`` children, plus
+``prefix.match`` / ``prefix.restore`` / ``prefix.insert`` and the
+step-level ``scheduler.step`` / ``spec.draft`` / ``spec.verify`` /
+``spec.rollback`` spans), emitted as ``trace.span`` events on the same
+JSONL log and exportable to Perfetto with ``python -m transformer_tpu.obs
+trace``. A request dict may carry a W3C ``"traceparent"`` — the root span
+parents under it, so a fronting router's trace context propagates across
+the process boundary. Error answers, retry/backoff attempts
+(``serve.retry`` events) and breaker transitions carry the victim
+request's ``trace`` id, so a chaos episode reconstructs as one tree.
+Tracing is host-side bookkeeping at the same boundaries as the metrics:
+answers stay byte-identical and the compiled programs are jaxpr-identical
+tracing on vs. off (``telemetry_inert`` contract + tests/test_trace.py).
+
+SLOs (``slos=`` — specs or a ``--slo_spec`` string, ``obs/slo.py``):
+every answer feeds a streaming burn-rate engine; ``serve_slo_burn_*``
+gauges and ``slo.burn`` breach-transition events ride the same telemetry,
+and ``python -m transformer_tpu.obs slo`` renders the report offline.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
@@ -311,6 +333,14 @@ class _Pending:
     # re-try this entry.
     attempts: int = 0
     not_before: float = 0.0
+    # Tracing (None when the scheduler has no tracer): the request's root
+    # span (submit -> answer) and the currently-open lifecycle child.
+    # span_admit/span_prefill ride here only during an admission attempt,
+    # so a transient-fault retry (or an admission error) can close them.
+    span_root: object = None
+    span_queue: object = None
+    span_admit: object = None
+    span_prefill: object = None
 
 
 @dataclasses.dataclass
@@ -356,6 +386,16 @@ class _Active:
     # queue, prefill, and decode-step boundaries; expiry frees the slot and
     # answers a structured "deadline" error with the partial continuation.
     deadline: float | None = None
+    # Tracing spans (None without a tracer): the root rides over from the
+    # _Pending; prefill closes when the LAST prompt token is in cache
+    # (exactly the t_prefill edge) and decode opens there.
+    span_root: object = None
+    span_prefill: object = None
+    span_decode: object = None
+
+    @property
+    def trace_id(self) -> "str | None":
+        return None if self.span_root is None else self.span_root.ctx.trace_id
 
 
 class SlotPool:
@@ -408,6 +448,7 @@ class ContinuousScheduler:
         breaker_threshold: int = 3,
         breaker_cooldown_s: float = 30.0,
         breaker_clock=time.monotonic,
+        slos=None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -511,6 +552,27 @@ class ContinuousScheduler:
         # byte-identical (tests/test_obs.py pins this) and the decode hot
         # path compiles the same programs (retrace budget stays 0).
         self._tel = telemetry
+        # Tracing rides the telemetry bundle (Telemetry(trace=True) /
+        # --trace); None disables every span site at one attribute check.
+        self._tracer = getattr(telemetry, "tracer", None)
+        # Victim attribution for breaker transitions: the trace id of the
+        # request whose fault is being recorded, set around the fallible
+        # regions (admission, retirement feed, drafting) on the scheduler
+        # thread — _on_breaker_transition stamps it into serve.breaker
+        # events so a chaos episode reconstructs as one trace tree.
+        self._breaker_trace: str | None = None
+        # SLO engine (obs/slo.py): burn-rate evaluation over the answer
+        # stream. `slos` is a spec tuple or an --slo_spec string; needs
+        # telemetry (gauges + slo.burn events are its whole output).
+        self._slo = None
+        if telemetry is not None and slos:
+            from transformer_tpu.obs.slo import SLOEngine, parse_slo_spec
+
+            specs = parse_slo_spec(slos) if isinstance(slos, str) else tuple(slos)
+            if specs:
+                self._slo = SLOEngine(
+                    specs, registry=telemetry.registry, emit=telemetry.emit
+                )
         if telemetry is not None:
             reg = telemetry.registry
             self._m_slots_total = reg.gauge(
@@ -579,18 +641,85 @@ class ContinuousScheduler:
     def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
         """Breaker state -> obs: a gauge (0 closed / 1 half-open / 2 open)
         plus a ``serve.breaker`` event per transition — `obs summarize`
-        derives degraded-time from the event stream. Host-side only; no-op
-        without telemetry."""
+        derives degraded-time from the event stream, and the event carries
+        the trace id of the request whose fault tripped it (when tracing).
+        Host-side only; no-op without telemetry."""
         if self._tel is None:
             return
         self._tel.registry.gauge(
             f"serve_breaker_state_{name}",
             "circuit-breaker state: 0 closed, 1 half-open, 2 open",
         ).set(BREAKER_STATE_VALUE[new])
-        self._tel.emit("serve.breaker", name=name, state=new, previous=old)
+        extra = {}
+        if self._breaker_trace is not None:
+            extra["trace"] = self._breaker_trace
+        self._tel.emit(
+            "serve.breaker", name=name, state=new, previous=old, **extra
+        )
+
+    # ---- tracing / SLO plumbing -------------------------------------------
+
+    def _traced(self, name: str, parent, **attrs):
+        """A ``tracer.span`` context (explicit parent — request-lifecycle
+        spans must tie to THEIR request's tree, never to whatever step span
+        happens to be current), or a no-op when tracing is off."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name, parent=parent, **attrs)
+
+    def _record_request(self, span: dict, root=None) -> None:
+        """The one answer-boundary funnel: every ``serve.request`` span
+        event goes through here so the trace id is stamped uniformly and
+        the SLO engine sees exactly what the log sees."""
+        if root is not None:
+            span.setdefault("trace", root.ctx.trace_id)
+        if self._slo is not None:
+            self._slo.record(dict(span))
+        if self._tel is not None:
+            self._tel.emit("serve.request", **span)
+
+    @staticmethod
+    def _end_spans(obj, attrs: "tuple[str, ...]", **fields) -> None:
+        """Close any still-open spans named by ``attrs`` on a _Pending or
+        _Active (defensive: every error path funnels through one of the
+        answer helpers, and a span left open would fail the completeness
+        tests)."""
+        for attr in attrs:
+            sp = getattr(obj, attr, None)
+            if sp is not None:
+                sp.end(**fields)
+                setattr(obj, attr, None)
+
+    def _trace_prefill_done(self, st: _Active) -> None:
+        """The prompt is fully in cache: close the prefill span and open
+        the decode span — called exactly where ``t_prefill`` is finalized
+        (admission for full-prefill requests, the boundary step for
+        chunked/tail-fed ones)."""
+        if st.span_prefill is not None:
+            st.span_prefill.end(prompt_tokens=st.prompt_len,
+                                prefix_hit_tokens=st.prefix_hit)
+            st.span_prefill = None
+            st.span_decode = self._tracer.start_span(
+                "serve.decode", parent=st.span_root, lane=st.span_root.lane
+            )
 
     def submit(self, req: dict) -> int:
         now = time.perf_counter()
+        # Root span BEFORE the lock (id generation is not free): parents
+        # under an incoming W3C "traceparent" when the request carries one
+        # — the cross-process hook the router tier rides. Invalid headers
+        # degrade to a fresh trace (W3C semantics), never an error.
+        root = queue_span = None
+        if self._tracer is not None:
+            from transformer_tpu.obs.trace import SpanContext
+
+            root = self._tracer.start_span(
+                "serve.request", lane="intake",
+                parent=SpanContext.from_traceparent(req.get("traceparent")),
+            )
+            queue_span = self._tracer.start_span(
+                "serve.queue", parent=root, lane="intake"
+            )
         refused = None  # the refusal message, captured INSIDE the lock —
         # reading self._done[order] back after release would race the
         # scheduler thread's drain_ready() popping it.
@@ -618,33 +747,53 @@ class ContinuousScheduler:
                     pass  # _start re-parses and answers the validation error
                 self._queue.append(
                     _Pending(order=order, req=req, t_enqueue=now,
-                             deadline=deadline)
+                             deadline=deadline, span_root=root,
+                             span_queue=queue_span)
                 )
                 if deadline is not None:
                     self._queued_deadlines += 1
+        if refused is not None and root is not None:
+            queue_span.end(error=refused)
+            root.end(order=order, error=refused, code="backpressure")
         if self._tel is not None:
             self._m_requests.inc()
             if refused is not None:
                 self._m_backpressure.inc()
                 self._m_errors.inc()
-                self._tel.emit(
-                    "serve.request", order=order, total_s=0.0, error=refused,
+                self._record_request(
+                    {"order": order, "total_s": 0.0, "error": refused,
+                     "code": "backpressure"},
+                    root=root,
                 )
         return order
 
     def submit_done(self, resp: dict) -> int:
+        root = None
+        if self._tracer is not None:
+            # Pre-answered (parse/routing) responses still get a (leaf)
+            # span: every output order is accounted for in the trace.
+            root = self._tracer.start_span("serve.request", lane="intake")
         with self._intake_lock:
             order = self._next_order
             self._next_order += 1
             self._done[order] = resp
+        if root is not None:
+            extra = {}
+            if "error" in resp:
+                extra["error"] = resp["error"]
+                if "code" in resp:  # taxonomy code, like every error root
+                    extra["code"] = resp["code"]
+            root.end(order=order, **extra)
         if self._tel is not None:
             self._m_requests.inc()
             if "error" in resp:
                 self._m_errors.inc()
-            self._tel.emit(
-                "serve.request", order=order, total_s=0.0,
-                **({"error": resp["error"]} if "error" in resp else {}),
-            )
+            span = {"order": order, "total_s": 0.0}
+            if "error" in resp:
+                span["error"] = resp["error"]
+                if "code" in resp:
+                    span["code"] = resp["code"]
+            self._record_request(span, root=root)
         return order
 
     def cancel(self, order: int, message: str = "cancelled by client") -> bool:
@@ -670,22 +819,25 @@ class ContinuousScheduler:
             self._cancel_pending[order] = message
         return True
 
-    def _answer_cancelled(
-        self, order: int, message: str, t_enqueue: float | None = None
-    ) -> None:
+    def _answer_cancelled(self, p: _Pending, message: str) -> None:
         """Answer a queued (never-admitted) cancellation — scheduler
         thread only, like every other queue answer."""
         self.stats["cancelled"] += 1
-        self._done[order] = error_answer("cancelled", message)
+        self._done[p.order] = error_answer("cancelled", message)
+        root = p.span_root
+        self._end_spans(p, ("span_queue", "span_admit", "span_prefill"))
+        self._end_spans(
+            p, ("span_root",), order=p.order, error=message, code="cancelled"
+        )
         if self._tel is not None:
             now = time.perf_counter()
             self._m_cancelled.inc()
             self._m_errors.inc()
-            span = {"order": order, "error": message}
-            if t_enqueue is not None:
-                span["queue_s"] = round(now - t_enqueue, 6)
-                span["total_s"] = round(now - t_enqueue, 6)
-            self._tel.emit("serve.request", **span)
+            span = {"order": p.order, "error": message, "code": "cancelled"}
+            if p.t_enqueue:
+                span["queue_s"] = round(now - p.t_enqueue, 6)
+                span["total_s"] = round(now - p.t_enqueue, 6)
+            self._record_request(span, root=root)
 
     @property
     def busy(self) -> bool:
@@ -749,20 +901,41 @@ class ContinuousScheduler:
             if cancel_msg is not None:
                 # Registered cancel caught before admission: answer without
                 # ever paying the prefill (or taking a slot).
-                self._answer_cancelled(p.order, cancel_msg, p.t_enqueue)
+                self._answer_cancelled(p, cancel_msg)
                 continue
             try:
-                self._start(p.order, p.req, p.t_enqueue)
+                self._start(p)
             except TransientError as e:
                 if p.attempts < self.admission_retries:
                     p.attempts += 1
-                    p.not_before = now + backoff_ms(
+                    wait_ms = backoff_ms(
                         self.retry_backoff_ms, p.attempts - 1, p.order
-                    ) / 1e3
+                    )
+                    p.not_before = now + wait_ms / 1e3
                     deferred.append(p)
                     self.stats["retries"] += 1
+                    # Spans opened by the failed attempt close with the
+                    # fault; the request goes back to queueing, so a fresh
+                    # queue span covers the backoff wait.
+                    self._end_spans(
+                        p, ("span_admit", "span_prefill"),
+                        error=f"{type(e).__name__}: {e}", retried=True,
+                    )
+                    if self._tracer is not None and p.span_queue is None:
+                        p.span_queue = self._tracer.start_span(
+                            "serve.queue", parent=p.span_root, lane="intake",
+                            attempt=p.attempts,
+                        )
                     if self._tel is not None:
                         self._m_retries.inc()
+                        retry_ev = {
+                            "order": p.order, "attempt": p.attempts,
+                            "backoff_ms": round(wait_ms, 3),
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                        if p.span_root is not None:
+                            retry_ev["trace"] = p.span_root.ctx.trace_id
+                        self._tel.emit("serve.retry", **retry_ev)
                     continue
                 self._answer_admission_error(p, e, now)
             except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — per-request isolation: ANY admission failure must answer this request alone, never kill co-batched ones
@@ -780,17 +953,29 @@ class ContinuousScheduler:
     def _answer_admission_error(
         self, p: _Pending, e: BaseException, now: float
     ) -> None:
-        self._done[p.order] = error_answer(
-            classify_error(e), f"{type(e).__name__}: {e}"
+        code = classify_error(e)
+        self._done[p.order] = error_answer(code, f"{type(e).__name__}: {e}")
+        root = p.span_root
+        self._end_spans(
+            p, ("span_queue", "span_admit", "span_prefill"),
+            error=type(e).__name__,
+        )
+        self._end_spans(
+            p, ("span_root",), order=p.order,
+            error=self._done[p.order]["error"], code=code,
         )
         if self._tel is not None:
             t_enq = p.t_enqueue
             self._m_errors.inc()
-            self._tel.emit(
-                "serve.request", order=p.order,
-                queue_s=round(now - t_enq, 6) if t_enq else 0.0,
-                total_s=round(now - t_enq, 6) if t_enq else 0.0,
-                error=self._done[p.order]["error"],
+            self._record_request(
+                {
+                    "order": p.order,
+                    "queue_s": round(now - t_enq, 6) if t_enq else 0.0,
+                    "total_s": round(now - t_enq, 6) if t_enq else 0.0,
+                    "error": self._done[p.order]["error"],
+                    "code": code,
+                },
+                root=root,
             )
 
     def _answer_expired(self, p: _Pending, now: float) -> None:
@@ -801,14 +986,24 @@ class ContinuousScheduler:
             f"deadline_ms elapsed after {round((now - p.t_enqueue) * 1e3)}ms "
             "in the admission queue",
         )
+        root = p.span_root
+        self._end_spans(p, ("span_queue", "span_admit", "span_prefill"))
+        self._end_spans(
+            p, ("span_root",), order=p.order,
+            error=self._done[p.order]["error"], code="deadline",
+        )
         if self._tel is not None:
             self._m_deadline.inc()
             self._m_errors.inc()
-            self._tel.emit(
-                "serve.request", order=p.order,
-                queue_s=round(now - p.t_enqueue, 6),
-                total_s=round(now - p.t_enqueue, 6),
-                error=self._done[p.order]["error"],
+            self._record_request(
+                {
+                    "order": p.order,
+                    "queue_s": round(now - p.t_enqueue, 6),
+                    "total_s": round(now - p.t_enqueue, 6),
+                    "error": self._done[p.order]["error"],
+                    "code": "deadline",
+                },
+                root=root,
             )
 
     def _expire(self, now: float) -> None:
@@ -847,7 +1042,7 @@ class ContinuousScheduler:
         else:
             pending, cancelled_q = {}, []
         for p in cancelled_q:
-            self._answer_cancelled(p.order, pending[p.order], p.t_enqueue)
+            self._answer_cancelled(p, pending[p.order])
         for slot, st in list(self._active.items()):
             if st.order in pending:
                 # Cancellation registered by cancel() (any thread),
@@ -891,21 +1086,52 @@ class ContinuousScheduler:
             self.stats["deadline_expired"] += 1
         else:
             self.stats["cancelled"] += 1
+        root = st.span_root
+        self._end_spans(st, ("span_prefill", "span_decode"))
+        self._end_spans(
+            st, ("span_root",), order=st.order, error=message, code=code,
+            new_tokens=len(st.emitted),
+        )
         if self._tel is not None:
             now = time.perf_counter()
             (self._m_deadline if code == "deadline"
              else self._m_cancelled).inc()
             self._m_errors.inc()
-            self._tel.emit(
-                "serve.request", order=st.order,
-                prompt_tokens=st.prompt_len, new_tokens=len(st.emitted),
-                queue_s=round(st.t_admit - st.t_enqueue, 6),
-                total_s=round(now - st.t_enqueue, 6),
-                error=message,
+            self._record_request(
+                {
+                    "order": st.order,
+                    "prompt_tokens": st.prompt_len,
+                    "new_tokens": len(st.emitted),
+                    "queue_s": round(st.t_admit - st.t_enqueue, 6),
+                    "total_s": round(now - st.t_enqueue, 6),
+                    "error": message,
+                    "code": code,
+                },
+                root=root,
             )
 
-    def _start(self, order: int, req: dict, t_enq: float = 0.0) -> None:
+    def _start(self, p: _Pending) -> None:
+        """Admission wrapper: breaker-fault attribution (set by the inner
+        body) must not outlive the admission — a stale trace id would be
+        stamped onto the NEXT cooldown-driven breaker transition, blaming
+        an unrelated request."""
+        try:
+            self._start_inner(p)
+        finally:
+            self._breaker_trace = None
+
+    def _start_inner(self, p: _Pending) -> None:
+        order, req, t_enq = p.order, p.req, p.t_enqueue
         maybe_fail("serve.prefill")  # chaos point: admission-time fault
+        if self._tracer is not None:
+            # The queue phase ends here (a retry re-opens it); everything
+            # from validation through the first pick is the admit span.
+            # Faults from here on feed breakers under this request's name.
+            self._end_spans(p, ("span_queue",))
+            p.span_admit = self._tracer.start_span(
+                "serve.admit", parent=p.span_root, lane="intake"
+            )
+            self._breaker_trace = p.span_root.ctx.trace_id
         prompt = str(req["prompt"])
         ids = [self.tok.bos_id, *self.tok.encode(prompt)]
         L = len(ids)
@@ -979,8 +1205,13 @@ class ContinuousScheduler:
             # go through the model forward — the admission pick needs
             # next-token logits, which a block restore cannot produce.
             try:
-                hit = self.prefix_cache.match(ids[: L - 1])
-                m = hit.tokens
+                with self._traced(
+                    "prefix.match", p.span_admit, lane="intake"
+                ) as msp:
+                    hit = self.prefix_cache.match(ids[: L - 1])
+                    m = hit.tokens
+                    if msp is not None:
+                        msp.set(hit_tokens=m)
             except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — prefix reuse is an optional accelerator: ANY cache failure (corrupt block, injected fault, trie bug) feeds the breaker and degrades THIS admission to full prefill; it must never answer the request with an error
                 self._brk_prefix.record_failure()
                 prefix_ok = False
@@ -989,13 +1220,25 @@ class ContinuousScheduler:
         n = m + n_suffix
         slot = self._free.pop()
         t_admit = time.perf_counter()
+        if self._tracer is not None:
+            # The slot is known now: the request's remaining lifecycle
+            # renders on this slot's lane (admit/queue stay on intake —
+            # they are scheduler work, not slot residency).
+            p.span_root.lane = f"slot{slot}"
+            p.span_prefill = self._tracer.start_span(
+                "serve.prefill", parent=p.span_root, lane=f"slot{slot}",
+            )
         try:
             if m:
                 try:
-                    self.pool.caches = _slot_restore(
-                        self.pool.caches, jnp.int32(slot),
-                        hit.stacked(self.max_total + self.speculate_k),
-                    )
+                    with self._traced(
+                        "prefix.restore", p.span_prefill,
+                        lane=f"slot{slot}", tokens=m,
+                    ):
+                        self.pool.caches = _slot_restore(
+                            self.pool.caches, jnp.int32(slot),
+                            hit.stacked(self.max_total + self.speculate_k),
+                        )
                 except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — same degradation contract as the match above: a failed restore falls back to full prefill (the slot's index reset makes any partial restore invisible), feeding the breaker instead of erroring the request
                     self._brk_prefix.record_failure()
                     prefix_ok = False
@@ -1044,9 +1287,16 @@ class ContinuousScheduler:
             # below at the first pick, making the span exact there.
             t_prefill=time.perf_counter(),
             deadline=deadline,
+            # Span ownership transfers from the _Pending to the slot state:
+            # from here on, answer paths close through st, not p.
+            span_root=p.span_root, span_prefill=p.span_prefill,
         )
+        p.span_root = p.span_prefill = None
         self._active[slot] = st
         self.stats["max_active"] = max(self.stats["max_active"], len(self._active))
+        self._end_spans(
+            p, ("span_admit",), slot=slot, prefix_hit_tokens=st.prefix_hit
+        )
         if deadline is not None and time.perf_counter() >= deadline:
             # Prefill-boundary deadline check: the prompt ingest alone
             # consumed the budget — answer now instead of decoding tokens
@@ -1071,10 +1321,17 @@ class ContinuousScheduler:
                 )
             except Exception:
                 # The pick failing must not leak the slot: restore the pool
-                # so the error answers this request alone (admit() catches).
+                # so the error answers this request alone (admit() catches;
+                # spans travel back to the _Pending so the answer path can
+                # close them).
                 del self._active[slot]
                 self._free.append(slot)
+                p.span_root, p.span_prefill = st.span_root, st.span_prefill
                 raise
+            if self._tracer is not None:
+                # The pick above synced the prefill: the whole prompt is in
+                # cache, decoding starts now.
+                self._trace_prefill_done(st)
             self._consume_pick(slot, st, tokv)
         self.stats["admitted"] += 1
         if self._tel is not None:
@@ -1094,6 +1351,8 @@ class ContinuousScheduler:
                 self._m_backlog.set(len(self._queue))
                 self._m_ready.set(len(self._done))
                 self._tel.maybe_flush()
+                if self._slo is not None:
+                    self._slo.maybe_evaluate()
             return
         if self.speculate_k:
             self._step_verify()
@@ -1102,6 +1361,12 @@ class ContinuousScheduler:
 
     def _step_plain(self) -> None:
         t_step = time.perf_counter()
+        step_span = None
+        if self._tracer is not None:
+            step_span = self._tracer.start_span(
+                "scheduler.step", lane="scheduler",
+                active=len(self._active), backlog=len(self._queue),
+            )
         N = self.num_slots
         toks = np.full((N,), PAD_ID, np.int32)
         keys = np.zeros((N, *np.shape(jax.random.PRNGKey(0))), np.uint32)
@@ -1142,8 +1407,12 @@ class ContinuousScheduler:
                 # here so it covers the whole prompt. Full-prefill slots pick
                 # their first token at admission and skip this transition.
                 st.t_prefill = time.perf_counter()
+                if self._tracer is not None:
+                    self._trace_prefill_done(st)
             self._consume_pick(slot, st, picks[slot])
         self.stats["steps"] += 1
+        if step_span is not None:
+            step_span.end()
         if self._tel is not None:
             # The np.asarray(_pick_pool) above was a real device sync, so
             # this window is genuine step time, not dispatch time.
@@ -1153,6 +1422,8 @@ class ContinuousScheduler:
             self._m_backlog.set(len(self._queue))
             self._m_ready.set(len(self._done))
             self._tel.maybe_flush()
+            if self._slo is not None:
+                self._slo.maybe_evaluate()
 
     def _step_verify(self) -> None:
         """One speculative verify step: every occupied slot feeds its
@@ -1167,6 +1438,15 @@ class ContinuousScheduler:
         answers are byte-identical to non-speculative serving
         (tests/test_speculative.py pins this)."""
         t_step = time.perf_counter()
+        step_span = draft_span = None
+        if self._tracer is not None:
+            step_span = self._tracer.start_span(
+                "scheduler.step", lane="scheduler",
+                active=len(self._active), backlog=len(self._queue),
+            )
+            draft_span = self._tracer.start_span(
+                "spec.draft", parent=step_span, lane="scheduler",
+            )
         N, W = self.num_slots, self.speculate_k + 1
         toks = np.full((N, W), PAD_ID, np.int32)
         keys = np.zeros((N, *np.shape(jax.random.PRNGKey(0))), np.uint32)
@@ -1182,6 +1462,8 @@ class ContinuousScheduler:
         rows: dict[int, tuple[list[int], int]] = {}
         for slot, st in self._active.items():
             drafter = self.drafter if (st.spec and spec_allowed) else None
+            # A drafter fault recorded below is this slot's request's fault.
+            self._breaker_trace = st.trace_id
             t_draft = time.perf_counter()
             try:
                 row, n_drafted = build_verify_row(
@@ -1207,6 +1489,13 @@ class ContinuousScheduler:
             keys[slot] = st.key
             positions[slot] = st.pos
             temps[slot] = st.temperature
+        self._breaker_trace = None
+        verify_span = None
+        if draft_span is not None:
+            draft_span.end(drafted=sum(n for _, n in rows.values()))
+            verify_span = self._tracer.start_span(
+                "spec.verify", parent=step_span, lane="scheduler", width=W,
+            )
         logits, self.pool.caches = _pool_verify(
             self.params, self.pool.caches, jnp.asarray(toks), self.cfg
         )
@@ -1279,16 +1568,28 @@ class ContinuousScheduler:
                 # ingested the final prompt token — close the prefill span
                 # here, exactly like the plain path's boundary transition.
                 st.t_prefill = time.perf_counter()
+                if self._tracer is not None:
+                    self._trace_prefill_done(st)
             for tok in emitted:
                 self._consume_pick(slot, st, tok)
                 if slot not in self._active:
                     break  # retired (EOS / budget): drop the row's tail
+        rollback_span = None
+        if verify_span is not None:
+            verify_span.end(drafted=drafted, accepted=accepted)
+            rollback_span = self._tracer.start_span(
+                "spec.rollback", parent=step_span, lane="scheduler"
+            )
         self.pool.caches = _pool_rollback(
             self.pool.caches, jnp.asarray(delta)
         )
+        if rollback_span is not None:
+            rollback_span.end()
         self.stats["steps"] += 1
         self.stats["drafted"] = self.stats.get("drafted", 0) + drafted
         self.stats["accepted"] = self.stats.get("accepted", 0) + accepted
+        if step_span is not None:
+            step_span.end(drafted=drafted, accepted=accepted)
         if self._tel is not None:
             self._m_step_s.observe(time.perf_counter() - t_step)
             self._m_steps.inc()
@@ -1302,6 +1603,8 @@ class ContinuousScheduler:
             self._m_backlog.set(len(self._queue))
             self._m_ready.set(len(self._done))
             self._tel.maybe_flush()
+            if self._slo is not None:
+                self._slo.maybe_evaluate()
 
     def _consumable(self, st: _Active, emitted: list[int]) -> int:
         """How many of a verify row's emissions ``_consume_pick`` will
@@ -1337,6 +1640,9 @@ class ContinuousScheduler:
             st.cur = tokv
 
     def _finish(self, slot: int, st: _Active) -> None:
+        # Attribution BEFORE the allow() below: a cooldown-driven
+        # open->half_open transition inside it belongs to this request.
+        self._breaker_trace = st.trace_id
         if (
             self.prefix_cache is not None and st.use_prefix
             and self._brk_prefix.allow()
@@ -1355,15 +1661,20 @@ class ContinuousScheduler:
             aligned = (st.prompt_len // B) * B
             if aligned:
                 try:
-                    evicted = self.prefix_cache.insert(
-                        st.ids, aligned,
-                        lambda start: jax.device_get(
-                            _slot_read_blocks(
-                                self.pool.caches, jnp.int32(slot),
-                                jnp.int32(start), B,
-                            )
-                        ),
-                    )
+                    with self._traced(
+                        "prefix.insert", st.span_root,
+                        lane=st.span_root.lane if st.span_root else None,
+                        tokens=aligned,
+                    ):
+                        evicted = self.prefix_cache.insert(
+                            st.ids, aligned,
+                            lambda start: jax.device_get(
+                                _slot_read_blocks(
+                                    self.pool.caches, jnp.int32(slot),
+                                    jnp.int32(start), B,
+                                )
+                            ),
+                        )
                 except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — feeding the trie is best-effort: a retirement-side cache fault (injected or real) feeds the breaker and this request simply does not donate its KV; its ANSWER is already computed and must still flush
                     self._brk_prefix.record_failure()
                 else:
@@ -1374,6 +1685,7 @@ class ContinuousScheduler:
                     self._brk_prefix.record_success()
                     if evicted and self._tel is not None:
                         self._m_prefix_evicted.inc(evicted)
+        self._breaker_trace = None
         text = _detokenize_rows(
             np.asarray([st.emitted], np.int32) if st.emitted
             else np.zeros((1, 0), np.int32),
@@ -1382,6 +1694,13 @@ class ContinuousScheduler:
         self._done[st.order] = {"continuation": text}
         del self._active[slot]
         self._free.append(slot)
+        root = st.span_root
+        self._end_spans(st, ("span_prefill",))
+        self._end_spans(st, ("span_decode",), new_tokens=len(st.emitted))
+        self._end_spans(
+            st, ("span_root",), order=st.order,
+            prompt_tokens=st.prompt_len, new_tokens=len(st.emitted),
+        )
         if self._tel is not None:
             now = time.perf_counter()
             queue_s = st.t_admit - st.t_enqueue
@@ -1415,7 +1734,7 @@ class ContinuousScheduler:
                 span["ttft_s"] = round(ttft_s, 6)
                 self._m_ttft_s.observe(ttft_s)
             self._m_retirements.inc()
-            self._tel.emit("serve.request", **span)
+            self._record_request(span, root=root)
 
     # ---- output -----------------------------------------------------------
 
@@ -1457,5 +1776,7 @@ class ContinuousScheduler:
             self.idle_backoff()
         out = self.drain_ready()
         if self._tel is not None:
+            if self._slo is not None:
+                self._slo.maybe_evaluate(force=True)
             self._tel.maybe_flush(force=True)
         return out

@@ -575,6 +575,9 @@ class Trainer:
         # points the loop already has (log/eval/epoch boundaries) — zero new
         # device ops, zero recompiles (analysis telemetry_inert contract).
         self.telemetry = telemetry
+        # Tracing (--trace): train.fit/train.step/train.eval/ckpt.* spans on
+        # the "train" lane of the same event log the scheduler traces into.
+        self._tracer = getattr(telemetry, "tracer", None)
         self._last_metrics: dict | None = None
         self._window_mark = (0, 0, 0.0)  # (steps, tokens, time) at last record
         if telemetry is not None:
@@ -626,7 +629,12 @@ class Trainer:
         async dispatch this histogram measures host dispatch latency (a
         host-stall detector); StepTimer's synced windows stay the
         device-throughput source of truth. DistributedTrainer re-invokes
-        this after swapping in its sharded steps."""
+        this after swapping in its sharded steps. With tracing on, the same
+        callables additionally run through ``obs.trace.traced_call`` — one
+        ``train.step`` span per dispatch, parented under the open
+        ``train.fit`` span (the contract pins that wrapper's jaxpr inertness
+        too). Both wrappers chain ``__wrapped__``, and every probe that
+        needs the jitted fn unwraps the CHAIN, not one level."""
         from transformer_tpu.obs.telemetry import timed_call
 
         self._m_dispatch = self.telemetry.registry.histogram(
@@ -635,24 +643,59 @@ class Trainer:
         self.train_step = timed_call(self.train_step, self._m_dispatch)
         if self.multi_step is not None:
             self.multi_step = timed_call(self.multi_step, self._m_dispatch)
+        if self._tracer is not None:
+            from transformer_tpu.obs.trace import traced_call
+
+            self.train_step = traced_call(
+                self.train_step, self._tracer, "train.step", lane="train"
+            )
+            if self.multi_step is not None:
+                self.multi_step = traced_call(
+                    self.multi_step, self._tracer, "train.step", lane="train"
+                )
 
     # ------------------------------------------------------------------ loop
+    def _span(self, name: str, **attrs):
+        """A ``train``-lane tracing span, or a no-op context without a
+        tracer — the trainer's sites all parent via the thread-local stack
+        (everything nests under the ``train.fit`` root)."""
+        if self._tracer is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return self._tracer.span(name, lane="train", **attrs)
+
     def evaluate(
         self,
         batches: Iterable,
         max_batches: int | None = None,
         guard: "PreemptionGuard | None" = None,
     ) -> None:
-        self.eval_metrics.reset()
-        for i, (src, tgt) in enumerate(batches):
-            if max_batches is not None and i >= max_batches:
-                break
-            if guard is not None and guard.should_stop:
-                return  # preemption: abandon eval, caller checkpoints
-            m = self.eval_step(self.state, src, tgt)
-            self.eval_metrics.update(m)
+        with self._span("train.eval"):
+            self.eval_metrics.reset()
+            for i, (src, tgt) in enumerate(batches):
+                if max_batches is not None and i >= max_batches:
+                    break
+                if guard is not None and guard.should_stop:
+                    return  # preemption: abandon eval, caller checkpoints
+                m = self.eval_step(self.state, src, tgt)
+                self.eval_metrics.update(m)
 
     def fit(
+        self,
+        train_ds,
+        test_ds=None,
+        rng: jax.Array | None = None,
+        epoch_callback: Callable[[int, "Trainer"], object] | None = None,
+    ) -> None:
+        """Tracing wrapper: the whole run is one ``train.fit`` span —
+        every step/eval/checkpoint span nests under it via the tracer's
+        thread-local stack, and the ``with`` closes it on every exit path
+        (returns, preemption, exceptions)."""
+        with self._span("train.fit", epochs=self.train_cfg.epochs):
+            self._fit(train_ds, test_ds, rng, epoch_callback)
+
+    def _fit(
         self,
         train_ds,
         test_ds=None,
@@ -693,9 +736,10 @@ class Trainer:
                         reason=f"{type(exc).__name__}: {exc}",
                     )
 
-            restored = self.checkpoint.restore_latest(
-                self.state, on_fallback=_ckpt_fallback
-            )
+            with self._span("ckpt.restore"):
+                restored = self.checkpoint.restore_latest(
+                    self.state, on_fallback=_ckpt_fallback
+                )
             if restored is not None:
                 self.state = restored
                 self.log_fn(f"restored checkpoint at step {int(self.state.step)}")
@@ -857,7 +901,8 @@ class Trainer:
                     or stop_early
                     or callback_stop
                 ):
-                    self.checkpoint.save(self.state)
+                    with self._span("ckpt.save", step=step):
+                        self.checkpoint.save(self.state)
                     if cfg.early_stop_patience:
                         self._save_plateau_state(step)
                 if stop_early:
@@ -998,7 +1043,16 @@ class Trainer:
         for name in ("train_step", "multi_step", "eval_step",
                      "train_step_fn", "multi_step_fn", "eval_step_fn"):
             fn = getattr(self, name, None)
-            fn = getattr(fn, "__wrapped__", fn)  # through timed_call
+            # Through the telemetry wrapper chain (timed_call, traced_call —
+            # tracing adds a second __wrapped__ layer), stopping at the
+            # jitted callable: jax.jit ALSO sets __wrapped__, and unwrapping
+            # past it would reach the raw Python fn, which has no cache.
+            while (
+                fn is not None
+                and not hasattr(fn, "_cache_size")
+                and hasattr(fn, "__wrapped__")
+            ):
+                fn = fn.__wrapped__
             probe = getattr(fn, "_cache_size", None)
             if probe is not None:
                 # The same accounting the analysis/retrace.py sentinel
@@ -1096,9 +1150,10 @@ class Trainer:
             self.profiler.stop(block_on=self.state)
         prefix = f"preemption (signal {guard.signal_received}) at step {step}: "
         if self.checkpoint is not None:
-            path = self.checkpoint.save(self.state)
-            # The save must be durable before we report it (and exit).
-            self.checkpoint.wait()
+            with self._span("ckpt.save", step=step, preempt=True):
+                path = self.checkpoint.save(self.state)
+                # The save must be durable before we report it (and exit).
+                self.checkpoint.wait()
             if self.train_cfg.early_stop_patience:
                 self._save_plateau_state(step)
             if path is not None:
