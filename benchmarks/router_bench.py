@@ -1,0 +1,218 @@
+"""Multi-replica router microbenchmark: the ROADMAP scale-out numbers.
+
+CPU-runnable (the relay-down policy decode_bench.py set): a
+repeated-system-prompt workload — every request carries one of a few
+shared system prompts plus a small unique tail — through the real
+subprocess serving tier (``cli.router``'s building blocks: one
+``serve/router.py`` Router over N ``serve/replica.py`` workers), swept
+across 1/2/4 replicas.
+
+    JAX_PLATFORMS=cpu python benchmarks/router_bench.py
+
+Prints ONE summary JSON line per replica count and appends
+``bench_rows.jsonl``-compatible rows (``--rows_out``) carrying the
+acceptance numbers:
+
+- **router p99 queue latency** (submit -> first dispatch) — the router
+  must not become the serialization point as replicas multiply;
+- **per-replica prefix hit rate** — prefix-affinity dispatch is what
+  keeps the per-replica ``PrefixCache`` warm, so the hit rate should
+  survive scale-out instead of diluting 1/N;
+- **redispatch count** — with ``--kill`` (default when replicas > 1) one
+  replica is SIGKILLed mid-workload: every accepted request must still
+  answer (zero loss), and the row pins how many rode the failover path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEC = {
+    "config": {
+        "num_layers": 2, "d_model": 32, "num_heads": 2, "dff": 64,
+        "max_position": 96, "decoder_only": True, "tie_output": True,
+        "dtype": "float32", "dropout_rate": 0.0,
+    },
+    "seed": 0,
+    "corpus": ["ab cd ef gh ij kl mn op qr st uv wx"] * 3,
+    "target_vocab_size": 300,
+}
+WORDS = SPEC["corpus"][0].split()
+
+
+def _workload(n_requests: int, n_systems: int, system_words: int):
+    """Repeated-system-prompt requests: request i carries system prompt
+    ``i % n_systems`` plus a 2-word unique-ish tail."""
+    reqs = []
+    for i in range(n_requests):
+        s = i % n_systems
+        system = " ".join(
+            WORDS[(s + j) % len(WORDS)] for j in range(system_words)
+        )
+        tail = f"{WORDS[i % len(WORDS)]} {WORDS[(i * 5 + 1) % len(WORDS)]}"
+        reqs.append({"prompt": f"{system} {tail}", "max_new": 4})
+    return reqs
+
+
+def _p(q: list[float], frac: float) -> float:
+    if not q:
+        return 0.0
+    s = sorted(q)
+    return s[min(len(s) - 1, int(frac * len(s)))]
+
+
+def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
+    from transformer_tpu.serve.replica import build_model_from_spec
+    from transformer_tpu.serve.router import ReplicaProcess, Router
+
+    _, _, tok = build_model_from_spec(SPEC)
+    worker = [
+        "--model_spec", spec_path,
+        "--serve_slots", str(args.slots),
+        "--prefix_cache_mb", "32",
+        "--prefix_block", str(args.prefix_block),
+        "--heartbeat_ms", "100",
+    ]
+    links = [ReplicaProcess.spawn(i, worker) for i in range(n_replicas)]
+    router = Router(
+        links, encode=tok.encode, bos_id=tok.bos_id,
+        affinity_block=args.prefix_block, heartbeat_timeout_s=10.0,
+    )
+    for link in links:
+        link.start_reader(router.inbox)
+
+    reqs = _workload(args.requests, max(1, n_replicas), args.system_words)
+    kill = args.kill and n_replicas > 1
+    t0 = time.perf_counter()
+    for r in reqs:
+        router.submit(dict(r))
+    answered = []
+    killed = False
+    deadline = time.time() + 300
+    while router.busy and time.time() < deadline:
+        router.pump()
+        answered.extend(router.drain_ready())
+        if kill and not killed and len(answered) >= args.requests // 4:
+            victim = max(router.links, key=lambda l: l.inflight)
+            if victim.inflight > 0:
+                os.kill(victim.pid(), signal.SIGKILL)
+                killed = True
+    answered.extend(router.drain_ready())
+    wall = time.perf_counter() - t0
+    ok = sum(1 for a in answered if "continuation" in a)
+
+    # Per-replica prefix accounting from the workers' shutdown reports.
+    for link in router.links:
+        if not link.dead:
+            try:
+                link.send({"type": "shutdown"})
+            except (OSError, ValueError):
+                pass
+    stats_deadline = time.time() + 15
+    while time.time() < stats_deadline and any(
+        l.final_stats is None and not l.dead for l in router.links
+    ):
+        router.pump(timeout=0.05)
+    per_replica = {}
+    for link in router.links:
+        st = link.final_stats or {}
+        prompt = int(st.get("prompt_tokens", 0))
+        hit = int(st.get("prefix_hit_tokens", 0))
+        per_replica[link.name] = {
+            "requests": link.answered,
+            "prefix_hit_rate": round(hit / prompt, 4) if prompt else None,
+            "prefill_forwards": st.get("prefill_forwards"),
+            "killed": link.dead,
+        }
+    router.shutdown()
+    return {
+        "replicas": n_replicas,
+        "requests": len(reqs),
+        "answered": len(answered),
+        "answered_ok": ok,
+        "wall_s": round(wall, 3),
+        "requests_per_sec": round(len(reqs) / wall, 2),
+        "queue_p50_s": round(_p(router.queue_latencies, 0.50), 6),
+        "queue_p99_s": round(_p(router.queue_latencies, 0.99), 6),
+        "redispatch_count": router.stats["redispatched"],
+        "failovers": router.stats["failovers"],
+        "killed_one": killed,
+        "per_replica": per_replica,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replica_counts", type=str, default="1,2,4")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--system_words", type=int, default=8,
+                   help="shared system-prompt length in words")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--prefix_block", type=int, default=4)
+    p.add_argument("--kill", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="SIGKILL one replica mid-workload (replicas > 1) "
+                        "to pin the zero-loss failover numbers")
+    p.add_argument("--rows_out", type=str, default="",
+                   help="append bench_rows.jsonl-compatible rows here "
+                        "('' = print them to stderr)")
+    args = p.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    device = f"{dev.platform}:{dev.device_kind}"
+    fd, spec_path = tempfile.mkstemp(suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump(SPEC, f)
+    rows = []
+    try:
+        for n in [int(x) for x in args.replica_counts.split(",") if x.strip()]:
+            result = run_sweep(n, args, spec_path)
+            print(json.dumps(result))
+            assert result["answered"] == result["requests"], (
+                "router lost requests"
+            )
+            hit_rates = [
+                r["prefix_hit_rate"]
+                for r in result["per_replica"].values()
+                if r["prefix_hit_rate"] is not None
+            ]
+            rows.append(json.dumps({
+                "metric": "router p99 queue latency",
+                "value": result["queue_p99_s"],
+                "unit": "s",
+                "config": {
+                    "replicas": n, "slots": args.slots,
+                    "requests": args.requests,
+                    "system_words": args.system_words,
+                    "prefix_block": args.prefix_block,
+                    "killed_one": result["killed_one"],
+                },
+                "requests_per_sec": result["requests_per_sec"],
+                "prefix_hit_rate_per_replica": hit_rates,
+                "redispatch_count": result["redispatch_count"],
+                "failovers": result["failovers"],
+                "device": device,
+                "vs_baseline": None,
+            }))
+    finally:
+        os.unlink(spec_path)
+    if args.rows_out:
+        with open(args.rows_out, "a", encoding="utf-8") as f:
+            f.write("\n".join(rows) + "\n")
+    else:
+        for row in rows:
+            print(row, file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -782,7 +782,7 @@ def test_schedules_canned_scenarios_clean():
         assert not r.deadlocks, r.name
     assert {r.name for r in results} == {
         "prefix_cache_contention", "registry_scrape_vs_create",
-        "prefetch_shutdown", "eventlog_writers",
+        "prefetch_shutdown", "eventlog_writers", "router_dispatch_tables",
     }
 
 

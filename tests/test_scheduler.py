@@ -206,6 +206,30 @@ def test_malformed_flood_stays_bounded(lm, capsys):
     assert peak <= 2 * 8  # backlog_cap for num_slots=2
 
 
+def test_submit_after_shutdown_answers_routing_error(lm):
+    """A submission landing after shutdown() answers a structured
+    'routing' error at its reserved order instead of queueing into a loop
+    nobody drives again — the window the multi-replica router's redispatch
+    path can hit on a draining replica. Requests accepted BEFORE the
+    shutdown keep their full contract."""
+    params, cfg, tok = lm
+    sched = ContinuousScheduler(params, cfg, tok, num_slots=2)
+    sched.submit({"prompt": "ab cd", "max_new": 3})
+    sched.shutdown()
+    late = sched.submit({"prompt": "ef gh", "max_new": 3})
+    assert late == 1
+    while sched.busy:
+        sched.admit()
+        sched.step()
+    out = sched.drain_ready()
+    assert len(out) == 2
+    assert "continuation" in out[0]  # pre-shutdown request still served
+    assert out[1]["code"] == "routing"
+    assert "shut down" in out[1]["error"]
+    # The refused request never entered the queue or took a slot.
+    assert sched.backlog == 0 and len(sched._free) == 2
+
+
 def test_serve_continuous_loop(lm, capsys):
     """cli.serve's continuous loop end-to-end (in-process): JSONL + raw +
     malformed + wrong-kind lines through the stdin queue; one response per

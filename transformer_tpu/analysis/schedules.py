@@ -892,6 +892,89 @@ def _scenario_eventlog(sched: DetScheduler, log_factory=None):
     return [writer(0), writer(1)], check
 
 
+def _scenario_router_tables(sched: DetScheduler):
+    """The multi-replica router's shared tables under adversarial
+    interleaving: a CLIENT thread submitting through the intake lock, the
+    ROUTER thread pumping dispatch/answer/heartbeat messages, and two
+    REPLICA threads feeding heartbeats and answers (including one
+    deliberate DUPLICATE answer — the failover race the order-keyed
+    funnel must collapse to at-most-once). Invariants: every accepted
+    order answers exactly once in arrival order, the duplicate is counted
+    and dropped, and the in-flight/load accounting returns to zero."""
+    from transformer_tpu.serve.router import ReplicaLink, Router
+
+    class _Scripted(ReplicaLink):
+        def __init__(self, index, name, mailbox):
+            super().__init__(index, name)
+            self.mailbox = mailbox
+
+        def send(self, msg):
+            self.mailbox.put(msg)
+
+    mailboxes = [DetQueue(sched), DetQueue(sched)]
+    links = [_Scripted(i, f"r{i}", mailboxes[i]) for i in range(2)]
+    # Constructed INSIDE the patched-module context: the router's intake
+    # lock and inbox queue are scheduler-aware twins.
+    router = Router(
+        links, encode=lambda s: [3, 4, 5, 6, 7, 8, 9, 10], bos_id=1,
+        affinity_block=4,
+    )
+    N = 3
+    drained: list = []
+
+    def client():
+        for i in range(N):
+            router.submit({"prompt": f"p{i}"})
+        router.submit_done(
+            {"error": "LM export serves 'prompt', not 'src'",
+             "code": "routing"}
+        )
+
+    def replica(idx: int):
+        def body():
+            while True:
+                msg = mailboxes[idx].get()
+                if msg.get("type") == "shutdown":
+                    return
+                rid = msg["rid"]
+                router.inbox.put(
+                    (idx, {"type": "hb", "backlog": 0, "free": 2, "active": 1})
+                )
+                router.inbox.put(
+                    (idx, {"type": "answer", "rid": rid,
+                           "resp": {"continuation": f"r{idx}"}})
+                )
+                if rid == 0:
+                    # The failover race: a second answer for an order the
+                    # funnel has already (or will have) accepted.
+                    router.inbox.put(
+                        (idx, {"type": "answer", "rid": rid,
+                               "resp": {"continuation": "dup"}})
+                    )
+        return body
+
+    def pump():
+        while len(drained) < N + 1:
+            router.pump(timeout=0.01)
+            drained.extend(router.drain_ready())
+        # Let one straggling duplicate land before shutting the fakes down.
+        router.pump(timeout=0.01)
+        for mb in mailboxes:
+            mb.put({"type": "shutdown"})
+
+    def check():
+        assert len(drained) == N + 1, f"answers lost: {drained}"
+        errors = [d for d in drained if "error" in d]
+        assert len(errors) == 1 and errors[0]["code"] == "routing"
+        assert router.stats["answered"] == N
+        assert router.stats["duplicate_answers"] == 1, router.stats
+        assert not router._inflight, "in-flight table leaked entries"
+        assert all(l.inflight == 0 for l in links), "load accounting drifted"
+        assert sum(l.dispatched for l in links) == N
+
+    return [client, pump, replica(0), replica(1)], check
+
+
 def _pkg_files(*modnames: str) -> list[str]:
     import importlib
 
@@ -934,6 +1017,17 @@ CANNED: dict[str, Scenario] = {
         modules=lambda: _pkg_modules("transformer_tpu.obs.events"),
         instrument=lambda: _pkg_files("transformer_tpu.obs.events"),
         max_schedules=64,
+    ),
+    "router_dispatch_tables": Scenario(
+        name="router_dispatch_tables",
+        setup=_scenario_router_tables,
+        modules=lambda: _pkg_modules("transformer_tpu.serve.router"),
+        instrument=lambda: _pkg_files("transformer_tpu.serve.router"),
+        # 4 threads (client / router pump / 2 replicas): the tree is too
+        # wide for bounded-exhaustive DFS — seeded-random distinct traces,
+        # per the explorer's >2-thread policy.
+        max_schedules=24,
+        random_mode=True,
     ),
 }
 

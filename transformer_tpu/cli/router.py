@@ -1,0 +1,224 @@
+"""Multi-replica serving front end: spawn N replica workers and route.
+
+    python -m transformer_tpu.cli.router --replicas 2 --export_path=model \\
+        --tgt_vocab_file=vocab.subwords --metrics_jsonl=/tmp/router.jsonl
+
+Same wire contract as ``cli.serve``: one JSONL request (or raw prompt
+line) per stdin line, one JSONL response per line, in request order. The
+router process itself never loads the model — it owns client intake, the
+prefix-affinity/least-loaded dispatch policy, heartbeat-fed liveness, and
+zero-loss failover (``serve/router.py``); each replica worker
+(``serve/replica.py``) is a subprocess running the continuous-batching
+scheduler over its own model copy. Killing a replica mid-stream loses no
+accepted request: its in-flight work is re-dispatched to survivors with
+original order, trace id, and deadline intact.
+
+With ``--metrics_jsonl=PATH`` the router logs to PATH and each replica to
+``PATH.rN``; merge the fleet view with::
+
+    python -m transformer_tpu.obs summarize PATH PATH.r0 PATH.r1
+    python -m transformer_tpu.obs trace PATH PATH.r0 PATH.r1 --out t.json
+
+``--disaggregate`` marks replica 0 prefill-only and the rest decode-only:
+prompts are ingested on the prefill side and their KV handed to decode
+replicas as prefix-cache blocks (docs/SERVING.md "Multi-replica router").
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sys
+import threading
+
+from absl import app, flags, logging
+
+FLAGS = flags.FLAGS
+
+
+def define_router_flags() -> None:
+    from transformer_tpu.cli.flags import define_metrics_flags
+
+    define_metrics_flags()
+    flags.DEFINE_integer("replicas", 2, "replica worker processes to spawn")
+    flags.DEFINE_string("export_path", "model", "export directory (per replica)")
+    flags.DEFINE_string("tgt_vocab_file", "tgt_vocab.subwords",
+                        "target subword vocab (router affinity + replicas)")
+    flags.DEFINE_string(
+        "model_spec", "",
+        "JSON test-model spec file (serve.replica build_model_from_spec) "
+        "instead of an export — the CI/bench bootstrap")
+    flags.DEFINE_boolean("kv_cache_int8", False, "int8 KV cache in replicas")
+    flags.DEFINE_integer("serve_slots", 4, "KV-cache slots per replica")
+    flags.DEFINE_integer("serve_max_total", 0, "per-slot KV budget")
+    flags.DEFINE_integer("prefill_chunk", 0, "replica prefill chunk")
+    flags.DEFINE_integer("max_len", 64, "default max_new per request")
+    flags.DEFINE_integer("speculate_k", 0, "replica speculative lookahead")
+    flags.DEFINE_integer("prefix_cache_mb", 64,
+                         "per-replica prefix KV cache budget (0 = off)")
+    flags.DEFINE_integer("prefix_block", 16, "prefix-cache block tokens")
+    flags.DEFINE_integer(
+        "affinity_block", 0,
+        "token-block granularity for prefix-affinity hashing "
+        "(0 = --prefix_block); prompts sharing their leading aligned "
+        "blocks route to the replica whose PrefixCache is warm")
+    flags.DEFINE_integer(
+        "affinity_slack", 4,
+        "load gap (in-flight + heartbeat backlog) past which an affine "
+        "request falls back to the least-loaded replica")
+    flags.DEFINE_integer(
+        "max_redispatch", 2,
+        "bounded failover: redispatches per request before answering a "
+        "structured 'transient' error")
+    flags.DEFINE_float("heartbeat_ms", 200.0, "replica heartbeat period")
+    flags.DEFINE_float(
+        "heartbeat_timeout", 5.0,
+        "seconds without a heartbeat before a replica is failed over "
+        "(0 = rely on pipe EOF / process exit only)")
+    flags.DEFINE_boolean(
+        "disaggregate", False,
+        "prefill/decode disaggregation: replica 0 ingests prompts only and "
+        "hands KV blocks to decode-only peers (docs/SERVING.md)")
+
+
+def worker_args_from_flags(replica_jsonl: str = "") -> list[str]:
+    """The replica-worker argv tail shared by every spawned process."""
+    out = [
+        "--serve_slots", str(FLAGS.serve_slots),
+        "--serve_max_total", str(FLAGS.serve_max_total),
+        "--prefill_chunk", str(FLAGS.prefill_chunk),
+        "--max_len", str(FLAGS.max_len),
+        "--speculate_k", str(FLAGS.speculate_k),
+        "--prefix_cache_mb", str(FLAGS.prefix_cache_mb),
+        "--prefix_block", str(FLAGS.prefix_block),
+        "--heartbeat_ms", str(FLAGS.heartbeat_ms),
+    ]
+    if FLAGS.model_spec:
+        out += ["--model_spec", FLAGS.model_spec]
+    else:
+        out += ["--export_path", FLAGS.export_path,
+                "--tgt_vocab_file", FLAGS.tgt_vocab_file]
+        if FLAGS.kv_cache_int8:
+            out += ["--kv_cache_int8"]
+    if replica_jsonl:
+        out += ["--metrics_jsonl", replica_jsonl]
+        if FLAGS.trace:
+            out += ["--trace"]
+    return out
+
+
+
+
+def route_lines(q: "queue.Queue", router) -> None:
+    """Drive the router from the stdin queue: parse lines (malformed/
+    wrong-kind ones answer immediately at a reserved order), pump
+    dispatch/answers, flush responses in arrival order — the
+    ``serve_continuous`` loop shape, one tier up."""
+    from transformer_tpu.serve.router import _RouterLineError, parse_router_line
+
+    eof = False
+    while not eof or router.busy:
+        while not eof:
+            try:
+                line = q.get_nowait()
+            except queue.Empty:
+                break
+            if line is None:
+                eof = True
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = parse_router_line(line)
+            except _RouterLineError as e:
+                # Bare message — byte-identical to the grouped path's
+                # kind-mismatch answer (cli/serve.py parity).
+                router.submit_done({"error": str(e), "code": "routing"})
+                continue
+            except Exception as e:  # noqa: BLE001 — bad line answers, never kills
+                router.submit_done({
+                    "error": f"{type(e).__name__}: {e}", "code": "validation",
+                })
+                continue
+            router.submit(req)
+        router.pump()
+        for resp in router.drain_ready():
+            print(json.dumps(resp), flush=True)
+
+
+def main(argv) -> None:
+    del argv
+    from transformer_tpu.cli.flags import flags_to_telemetry
+    from transformer_tpu.serve.router import ReplicaProcess, Router
+
+    telemetry = flags_to_telemetry()
+    # Affinity hashing needs only the tokenizer — the router never loads
+    # the model or compiles a program, so it restarts cheaply and
+    # survives replica OOMs.
+    if FLAGS.model_spec:
+        with open(FLAGS.model_spec) as f:
+            spec = json.load(f)
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+        tok = SubwordTokenizer.build_from_corpus(
+            list(spec["corpus"]),
+            target_vocab_size=int(spec.get("target_vocab_size", 300)),
+        )
+    else:
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+        tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+
+    n = max(1, FLAGS.replicas)
+    links = []
+    for i in range(n):
+        role = "both"
+        if FLAGS.disaggregate:
+            role = "prefill" if i == 0 else "decode"
+        replica_jsonl = (
+            f"{FLAGS.metrics_jsonl}.r{i}" if FLAGS.metrics_jsonl else ""
+        )
+        links.append(
+            ReplicaProcess.spawn(
+                i, worker_args_from_flags(replica_jsonl), role=role,
+            )
+        )
+    router = Router(
+        links,
+        encode=tok.encode,
+        bos_id=tok.bos_id,
+        affinity_block=FLAGS.affinity_block or FLAGS.prefix_block,
+        affinity_slack=FLAGS.affinity_slack,
+        max_redispatch=FLAGS.max_redispatch,
+        heartbeat_timeout_s=FLAGS.heartbeat_timeout,
+        disaggregate=FLAGS.disaggregate,
+        telemetry=telemetry,
+    )
+    for link in links:
+        link.start_reader(router.inbox)
+    logging.info(
+        "router up: %d replica(s) x %d slots, affinity block %d%s",
+        n, FLAGS.serve_slots, FLAGS.affinity_block or FLAGS.prefix_block,
+        ", disaggregated prefill/decode" if FLAGS.disaggregate else "",
+    )
+
+    from transformer_tpu.serve.replica import stdin_reader
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_slots * n) * 8)
+    threading.Thread(target=stdin_reader, args=(q,), daemon=True).start()
+    try:
+        route_lines(q, router)
+    finally:
+        router.shutdown()
+        if telemetry is not None:
+            telemetry.close()
+
+
+def run() -> None:
+    define_router_flags()
+    app.run(main)
+
+
+if __name__ == "__main__":
+    run()

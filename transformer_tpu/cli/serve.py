@@ -401,10 +401,6 @@ def serve_continuous(q: queue.Queue, sched, model_cfg, telemetry=None) -> None:
         telemetry.maybe_flush(force=True)
 
 
-def _stdin_reader(q: queue.Queue) -> None:
-    for line in sys.stdin:
-        q.put(line)
-    q.put(None)  # EOF sentinel
 
 
 def main(argv) -> None:
@@ -460,8 +456,10 @@ def main(argv) -> None:
     # Bounded queue: the reader thread blocks on put() once it is this far
     # ahead, restoring the stdin backpressure a blocking read loop has — a
     # piped multi-GB request file must not accumulate in host memory.
+    from transformer_tpu.serve.replica import stdin_reader
+
     q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_batch) * 8)
-    threading.Thread(target=_stdin_reader, args=(q,), daemon=True).start()
+    threading.Thread(target=stdin_reader, args=(q,), daemon=True).start()
     if continuous:
         from transformer_tpu.obs.slo import DEFAULT_SLOS
         from transformer_tpu.serve import (

@@ -153,6 +153,39 @@ def summarize_events(events: list[dict]) -> dict:
         if breakers:
             report.setdefault("serve", {})["breakers"] = breakers
 
+    # ---- router: multi-replica dispatch / failover ------------------------
+    dispatches = [e for e in events if e.get("kind") == "route.dispatch"]
+    failovers = [e for e in events if e.get("kind") == "route.failover"]
+    if dispatches or failovers:
+        per_replica: dict[str, int] = {}
+        redispatches = 0
+        for d in dispatches:
+            name = str(d.get("replica"))
+            if int(d.get("redispatch", 0) or 0) > 0:
+                redispatches += 1
+                continue  # request share counts FIRST dispatches only
+            if d.get("stage") == "prefill":
+                continue  # disaggregated stage 1: the request's share is
+                #           attributed to the replica that DECODES it
+            per_replica[name] = per_replica.get(name, 0) + 1
+        total = sum(per_replica.values())
+        report["router"] = {
+            "dispatches": len(dispatches),
+            "requests": total,
+            "redispatches": redispatches,
+            "failovers": len(failovers),
+            "failed_over_requests": sum(
+                len(f.get("orders", ())) for f in failovers
+            ),
+            "replicas": {
+                name: {
+                    "requests": n,
+                    "share": round(n / total, 4) if total else None,
+                }
+                for name, n in sorted(per_replica.items())
+            },
+        }
+
     # ---- serve: grouped-path batches --------------------------------------
     batches = [e for e in events if e.get("kind") == "serve.batch"]
     if batches:
@@ -369,6 +402,25 @@ def render_text(report: dict) -> str:
                 for name, b in sorted(brk.items())
             ]
             lines.append("  breakers: " + "; ".join(parts))
+    router = report.get("router")
+    if router:
+        line = (
+            f"router: {router['requests']} requests over "
+            f"{len(router['replicas'])} replica(s)"
+        )
+        if router.get("failovers"):
+            line += (
+                f"; {router['failovers']} failover(s), "
+                f"{router['failed_over_requests']} request(s) failed over, "
+                f"{router['redispatches']} redispatched"
+            )
+        lines.append(line)
+        for name, rep in sorted(router["replicas"].items()):
+            share = (
+                f" ({rep['share'] * 100:.1f}%)"
+                if rep.get("share") is not None else ""
+            )
+            lines.append(f"  {name}: {rep['requests']} requests{share}")
     grouped = report.get("serve_grouped")
     if grouped:
         line = (

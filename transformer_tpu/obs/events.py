@@ -18,6 +18,13 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
 - ``serve.retry`` — one per transient-admission retry: ``order``,
   ``attempt``, ``backoff_ms``, the fault, and the victim's ``trace`` id
   when tracing is on.
+- ``route.dispatch`` / ``route.failover`` / ``route.revive`` — the
+  multi-replica router's events (``serve/router.py``): per-request
+  dispatch decisions (replica, policy, redispatch count, ``trace``),
+  replica failures with the victim orders + trace ids, and half-open
+  breaker revivals of heartbeat-timeout victims; ``obs summarize
+  --merge`` reports per-replica request share and redispatches from
+  these.
 - ``metrics.snapshot`` — periodic full registry dump (histograms as
   count/sum/min/max/p50/p95/p99).
 - ``bench.relay_probe`` / ``bench.fallback_row`` / ``bench.attempt`` —

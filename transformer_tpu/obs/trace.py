@@ -282,8 +282,11 @@ def traced_call(fn, tracer: Tracer, name: str, lane: "str | None" = None,
 
 #: Fixed lane -> tid mapping: control lanes first, then one lane per serve
 #: slot (``slot0``.. at tid 10+), so every export of the same run lays out
-#: identically. Unknown lanes allocate past the slots.
-_CONTROL_LANES = {"intake": 1, "scheduler": 2, "train": 3}
+#: identically. Unknown lanes allocate past the slots. ``router`` is the
+#: front-end dispatcher's own lane (serve/router.py) — in a multi-source
+#: merge the router's log is additionally its own PROCESS row, since
+#: processes key on the ``source`` tag.
+_CONTROL_LANES = {"intake": 1, "scheduler": 2, "train": 3, "router": 4}
 _SLOT_TID_BASE = 10
 
 

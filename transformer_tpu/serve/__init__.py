@@ -6,7 +6,11 @@ KV cache — radix-trie prompt reuse feeding slot admission
 (``transformer_tpu/serve/prefix_cache.py``) — and the fault-tolerance
 surface: deterministic fault injection, request deadlines/cancellation,
 and the circuit-breaker degradation ladder
-(``transformer_tpu/serve/resilience.py``, docs/ROBUSTNESS.md)."""
+(``transformer_tpu/serve/resilience.py``, docs/ROBUSTNESS.md) — plus the
+multi-replica serving tier: a prefix-affinity front-end router with
+zero-loss failover over replica worker processes
+(``transformer_tpu/serve/router.py`` / ``replica.py``,
+docs/SERVING.md "Multi-replica router")."""
 
 from transformer_tpu.serve.prefix_cache import (
     PrefixCache,
@@ -18,6 +22,11 @@ from transformer_tpu.serve.resilience import (
     FaultPlane,
     InjectedFault,
     TransientError,
+)
+from transformer_tpu.serve.router import (
+    ReplicaLink,
+    ReplicaProcess,
+    Router,
 )
 from transformer_tpu.serve.scheduler import ContinuousScheduler, SlotPool
 from transformer_tpu.serve.speculative import (
@@ -35,6 +44,9 @@ __all__ = [
     "PrefixCache",
     "PrefixCorruptionError",
     "PrefixHit",
+    "ReplicaLink",
+    "ReplicaProcess",
+    "Router",
     "SlotPool",
     "TransientError",
     "ModelDrafter",

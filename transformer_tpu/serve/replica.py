@@ -1,0 +1,395 @@
+"""Replica worker: one model copy + one ``ContinuousScheduler`` behind a pipe.
+
+The unit the router (``serve/router.py``) multiplies. Launched as
+
+    python -m transformer_tpu.serve.replica --export_path=model \\
+        --tgt_vocab_file=vocab.subwords [scheduler flags...]
+
+it loads its own model copy (or builds a deterministic test model from a
+``--model_spec`` JSON — the CI/bench bootstrap), wraps the EXISTING
+continuous-batching scheduler around it, and speaks a line-oriented JSON
+protocol on stdin/stdout:
+
+router -> replica:
+    {"type": "req",      "rid": N, "req": {...}}          serve a request
+    {"type": "req",      "rid": N, "req": {...},
+     "blocks": ..., "tokens": T}                          ...after a prefill
+                                                          handoff (inject T
+                                                          prompt tokens' KV
+                                                          into the local
+                                                          PrefixCache first)
+    {"type": "prefill",  "rid": N, "req": {...}}          disaggregation
+                                                          stage 1: ingest the
+                                                          prompt, export its
+                                                          KV blocks, answer
+                                                          "prefilled"
+    {"type": "shutdown"}                                  drain + exit
+
+replica -> router:
+    {"type": "ready", "replica": name, "slots": N}
+    {"type": "hb", "backlog": B, "free": F, "active": A}  heartbeat (the
+                                                          least-loaded gauges)
+    {"type": "answer", "rid": N, "resp": {...}}           one per request
+    {"type": "prefilled", "rid": N, "tokens": T, "blocks": ...}
+    {"type": "stats", "stats": {...}}                     final, at shutdown
+
+``rid`` is the ROUTER's order for the request — the replica never invents
+identity, so the router's order-keyed answer funnel stays authoritative.
+Every forwarded request carries the router-minted ``traceparent``; with
+``--metrics_jsonl`` + ``--trace`` this replica's spans parent under the
+router's ``route.request`` span and ``obs summarize/trace/slo --merge``
+re-joins the fleet trace (docs/OBSERVABILITY.md).
+
+**KV handoff format** (disaggregation): the prompt's KV crosses the
+process boundary as the prefix cache's OWN host-side token-aligned blocks
+(``serve/prefix_cache.py``) — per layer, per ``block_tokens`` positions,
+in the cache's storage layout — serialized as base64 ``tobytes`` with
+dtype/shape. :func:`export_blocks` reads them out of this replica's
+``PrefixCache`` after a ``max_new=0`` admission fed them; the decode side
+:func:`inject_blocks` inserts them into ITS cache so admission restores
+them with zero model forwards (the prefix-cache byte-parity contract makes
+the handoff answer-invariant).
+
+Sharding: on a multi-device host, ``parallel/mesh.py`` machinery shards
+each replica's params exactly as ``cli/serve.py`` would — this worker
+adds process isolation on top, not a new parallelism scheme. CI runs it
+on plain CPU processes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import queue
+import sys
+import threading
+import time
+
+
+def _msg_out(msg: dict) -> None:
+    """One protocol line on stdout. The main loop is the only writer, so
+    lines are never torn; flush per line — the router reads a pipe."""
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+# --------------------------------------------------------------------------
+# deterministic test-model bootstrap (CI, benches)
+
+
+def build_model_from_spec(spec: dict):
+    """(params, cfg, tok) from a model-spec dict — the deterministic
+    bootstrap the router tests and benches use: every process (replicas
+    AND the in-process single-scheduler reference) that builds the same
+    spec gets bit-identical params and vocab, so byte-parity assertions
+    are meaningful across process boundaries.
+
+    Spec shape::
+
+        {"config": {...ModelConfig overrides (vocab sizes filled from the
+                    corpus tokenizer)...},
+         "seed": 0,
+         "corpus": ["line", ...],
+         "target_vocab_size": 300}
+    """
+    import jax
+
+    from transformer_tpu.config import ModelConfig
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+    from transformer_tpu.models import transformer_init
+
+    tok = SubwordTokenizer.build_from_corpus(
+        list(spec["corpus"]),
+        target_vocab_size=int(spec.get("target_vocab_size", 300)),
+    )
+    cfg = ModelConfig(
+        **{
+            **dict(spec.get("config", {})),
+            "input_vocab_size": tok.model_vocab_size,
+            "target_vocab_size": tok.model_vocab_size,
+        }
+    )
+    params = transformer_init(jax.random.PRNGKey(int(spec.get("seed", 0))), cfg)
+    return params, cfg, tok
+
+
+# --------------------------------------------------------------------------
+# KV-block handoff (disaggregated prefill/decode)
+
+
+def _encode_array(a) -> dict:
+    import numpy as np
+
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": a.dtype.str,
+        "shape": list(a.shape),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _decode_array(d: dict):
+    import numpy as np
+
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"])
+
+
+def export_blocks(cache, ids: "list[int]") -> "tuple[int, list]":
+    """Read the longest block-aligned prefix of ``ids`` out of ``cache``
+    (a ``PrefixCache`` a ``max_new=0`` admission just fed) as the wire
+    payload: ``payload[j]`` is block j — per-layer dicts of serialized
+    arrays in the cache's own storage layout. Returns ``(tokens,
+    payload)``; (0, []) when nothing aligned is stored (budget pressure) —
+    the decode side then simply full-prefills."""
+    B = cache.block_tokens
+    aligned = (len(ids) // B) * B
+    if not aligned:
+        return 0, []
+    hit = cache.match(ids[:aligned])
+    try:
+        payload = [
+            [
+                {key: _encode_array(layer[key]) for key in sorted(layer)}
+                for layer in node.blocks
+            ]
+            for node in hit._nodes
+        ]
+        return hit.tokens, payload
+    finally:
+        hit.release()
+
+
+def inject_blocks(cache, ids: "list[int]", tokens: int, payload: list) -> int:
+    """Insert a handoff payload into the local ``PrefixCache`` so the next
+    admission of ``ids`` restores it without a model forward. Returns the
+    tokens actually inserted (the cache's budget may admit fewer)."""
+    B = cache.block_tokens
+    tokens = min(int(tokens), (len(ids) // B) * B, len(payload) * B)
+    if tokens <= 0:
+        return 0
+    blocks = [
+        [
+            {key: _decode_array(d) for key, d in layer.items()}
+            for layer in blk
+        ]
+        for blk in payload
+    ]
+    cache.insert(ids[:tokens], tokens, lambda start: blocks[start // B])
+    return tokens
+
+
+# --------------------------------------------------------------------------
+# the worker
+
+
+def _parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="router replica worker")
+    p.add_argument("--replica_name", default="replica0")
+    p.add_argument("--role", choices=("both", "prefill", "decode"),
+                   default="both")
+    p.add_argument("--export_path", default="")
+    p.add_argument("--tgt_vocab_file", default="")
+    p.add_argument("--model_spec", default="",
+                   help="JSON file with a deterministic test-model spec "
+                        "(build_model_from_spec) — CI/bench bootstrap")
+    p.add_argument("--kv_cache_int8", action="store_true")
+    p.add_argument("--serve_slots", type=int, default=4)
+    p.add_argument("--serve_max_total", type=int, default=0)
+    p.add_argument("--prefill_chunk", type=int, default=0)
+    p.add_argument("--max_len", type=int, default=64,
+                   help="default max_new per request")
+    p.add_argument("--speculate_k", type=int, default=0)
+    p.add_argument("--prefix_cache_mb", type=int, default=0)
+    p.add_argument("--prefix_block", type=int, default=16)
+    p.add_argument("--max_backlog", type=int, default=0)
+    p.add_argument("--heartbeat_ms", type=float, default=200.0)
+    p.add_argument("--metrics_jsonl", default="")
+    p.add_argument("--trace", action="store_true")
+    p.add_argument("--fault_spec", default="")
+    return p.parse_args(argv)
+
+
+def stdin_reader(q: "queue.Queue") -> None:
+    """Feed stdin lines into ``q``, then a ``None`` EOF sentinel — the one
+    line-intake reader shared by this worker, ``cli/serve.py``, and
+    ``cli/router.py`` (all three speak the same line protocol, so EOF and
+    encoding behavior must never diverge between them)."""
+    for line in sys.stdin:
+        q.put(line)
+    q.put(None)
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv)
+    if args.fault_spec:
+        from transformer_tpu.serve import resilience
+
+        resilience.install(resilience.FaultPlane.parse(args.fault_spec))
+
+    telemetry = None
+    if args.metrics_jsonl:
+        from transformer_tpu.obs import EventLog, Telemetry
+
+        telemetry = Telemetry(
+            events=EventLog(args.metrics_jsonl), trace=args.trace
+        )
+
+    if args.model_spec:
+        with open(args.model_spec) as f:
+            spec = json.load(f)
+        params, cfg, tok = build_model_from_spec(spec)
+    else:
+        from transformer_tpu.cli.translate import load_export
+        from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+        params, cfg = load_export(
+            args.export_path, kv_cache_int8=args.kv_cache_int8
+        )
+        tok = SubwordTokenizer.load(args.tgt_vocab_file)
+
+    from transformer_tpu.serve import ContinuousScheduler, PrefixCache
+
+    prefix_cache = None
+    disaggregated = args.role in ("prefill", "decode")
+    if args.prefix_cache_mb > 0 or disaggregated:
+        # Disaggregation rides the prefix-cache block format on BOTH
+        # sides: the prefill worker exports through its cache, the decode
+        # worker injects into its own — so both roles get one by default.
+        prefix_cache = PrefixCache(
+            cfg,
+            block_tokens=args.prefix_block,
+            budget_mb=max(1, args.prefix_cache_mb or 64),
+        )
+    sched = ContinuousScheduler(
+        params, cfg, tok,
+        num_slots=args.serve_slots,
+        max_total=args.serve_max_total or None,
+        prefill_chunk=args.prefill_chunk,
+        default_max_new=args.max_len,
+        telemetry=telemetry,
+        speculate_k=args.speculate_k,
+        prefix_cache=prefix_cache,
+        max_backlog=args.max_backlog,
+    )
+
+    q: queue.Queue = queue.Queue()
+    threading.Thread(target=stdin_reader, args=(q,), daemon=True).start()
+    _msg_out({
+        "type": "ready", "replica": args.replica_name,
+        "slots": args.serve_slots, "role": args.role,
+    })
+
+    hb_s = max(args.heartbeat_ms, 1.0) / 1e3
+    last_hb = 0.0
+    # rid bookkeeping: the scheduler answers in arrival order and this
+    # loop is the only submitter, so a FIFO of rids (parallel to the
+    # submission sequence) maps drained responses back to router orders.
+    rid_fifo: "list[int]" = []
+    prefill_rids: "set[int]" = set()
+    prompt_ids: "dict[int, list[int]]" = {}
+
+    def ingest(msg: dict) -> bool:
+        """Handle one control message; returns False on shutdown."""
+        kind = msg.get("type")
+        if kind == "shutdown":
+            sched.shutdown()
+            return False
+        if kind not in ("req", "prefill"):
+            return True
+        rid = msg.get("rid")
+        req = msg.get("req")
+        if not isinstance(req, dict):
+            req = {"prompt": ""}
+        if kind == "prefill":
+            # Disaggregation stage 1: ingest the prompt only (max_new=0
+            # feeds the prefix cache at retirement), then export its KV.
+            req = {**req, "max_new": 0, "cache_prefix": True}
+            prefill_rids.add(rid)
+        if prefix_cache is not None:
+            try:
+                ids = [tok.bos_id, *tok.encode(str(req.get("prompt", "")))]
+            except Exception:  # tpa: disable=TPA006 — the scheduler's admission answers the validation error; the handoff bookkeeping just skips it
+                ids = []
+            prompt_ids[rid] = ids
+            if kind == "req" and msg.get("blocks") and ids:
+                try:
+                    inject_blocks(
+                        prefix_cache, ids, msg.get("tokens", 0),
+                        msg["blocks"],
+                    )
+                except Exception:  # tpa: disable=TPA006 — a corrupt handoff payload degrades to full prefill (the cache just misses); it must never kill the worker
+                    pass
+        sched.submit(req)
+        rid_fifo.append(rid)
+        return True
+
+    def flush_answers() -> None:
+        for resp in sched.drain_ready():
+            rid = rid_fifo.pop(0)
+            if rid in prefill_rids:
+                prefill_rids.discard(rid)
+                tokens, payload = 0, []
+                ids = prompt_ids.pop(rid, [])
+                if "error" not in resp and prefix_cache is not None and ids:
+                    try:
+                        tokens, payload = export_blocks(prefix_cache, ids)
+                    except Exception:  # tpa: disable=TPA006 — export is best-effort: a failed handoff falls back to full prefill on the decode side
+                        tokens, payload = 0, []
+                _msg_out({
+                    "type": "prefilled", "rid": rid,
+                    "tokens": tokens, "blocks": payload,
+                })
+            else:
+                prompt_ids.pop(rid, None)
+                _msg_out({"type": "answer", "rid": rid, "resp": resp})
+
+    alive = True
+    while alive or sched.busy:
+        # Ingest whatever the router already sent; block only when idle.
+        while alive:
+            try:
+                if sched.busy or sched.has_ready:
+                    line = q.get_nowait()
+                else:
+                    # Idle: block, but wake often enough that heartbeats
+                    # keep flowing (the router's liveness gauge).
+                    line = q.get(timeout=hb_s)
+            except queue.Empty:
+                break
+            if line is None:
+                alive = False
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if not ingest(msg):
+                alive = False
+                break
+        sched.admit()
+        sched.step()
+        sched.idle_backoff()
+        flush_answers()
+        now = time.monotonic()
+        if now - last_hb >= hb_s:
+            last_hb = now
+            _msg_out({
+                "type": "hb",
+                "backlog": sched.backlog,
+                "free": sched.num_slots - sched.active_count,
+                "active": sched.active_count,
+            })
+    flush_answers()
+    _msg_out({"type": "stats", "stats": dict(sched.stats)})
+    if telemetry is not None:
+        telemetry.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -498,10 +498,14 @@ class ContinuousScheduler:
         self._next_order = 0
         self._emit_next = 0
         # Intake lock: submit/submit_done allocate output orders and append
-        # to the queue from CLIENT threads (the multi-replica router will
-        # have several); admission/stepping stay single-threaded on the
+        # to the queue from CLIENT threads (the multi-replica router has
+        # several); admission/stepping stay single-threaded on the
         # scheduler's own loop.
         self._intake_lock = threading.Lock()
+        # shutdown() flips this: late submissions (the router's redispatch
+        # window can race a draining replica) answer a structured
+        # "routing" error instead of queueing into a loop nobody drives.
+        self._closed = False
         # Orders whose cancellation was requested (order -> message):
         # registered from ANY thread under the intake lock, EXECUTED by the
         # scheduler loop at the next step boundary (_expire) — the queue
@@ -723,10 +727,22 @@ class ContinuousScheduler:
         refused = None  # the refusal message, captured INSIDE the lock —
         # reading self._done[order] back after release would race the
         # scheduler thread's drain_ready() popping it.
+        refused_code = "backpressure"
         with self._intake_lock:
             order = self._next_order
             self._next_order += 1
-            if self.max_backlog and len(self._queue) >= self.max_backlog:
+            if self._closed:
+                # Post-shutdown submission (the router's redispatch path
+                # hits this window): answer NOW with a structured routing
+                # error — queueing would strand the request in a loop that
+                # will never admit again.
+                refused = (
+                    "scheduler is shut down and accepts no new requests; "
+                    "resubmit to a live replica"
+                )
+                refused_code = "routing"
+                self._done[order] = error_answer(refused_code, refused)
+            elif self.max_backlog and len(self._queue) >= self.max_backlog:
                 # Bounded admission backpressure: refuse NOW with a
                 # structured error instead of queueing without bound — the
                 # client sees a retryable condition while in-flight
@@ -754,15 +770,16 @@ class ContinuousScheduler:
                     self._queued_deadlines += 1
         if refused is not None and root is not None:
             queue_span.end(error=refused)
-            root.end(order=order, error=refused, code="backpressure")
+            root.end(order=order, error=refused, code=refused_code)
         if self._tel is not None:
             self._m_requests.inc()
             if refused is not None:
-                self._m_backpressure.inc()
+                if refused_code == "backpressure":
+                    self._m_backpressure.inc()
                 self._m_errors.inc()
                 self._record_request(
                     {"order": order, "total_s": 0.0, "error": refused,
-                     "code": "backpressure"},
+                     "code": refused_code},
                     root=root,
                 )
         return order
@@ -1735,6 +1752,19 @@ class ContinuousScheduler:
                 self._m_ttft_s.observe(ttft_s)
             self._m_retirements.inc()
             self._record_request(span, root=root)
+
+    # ---- shutdown ---------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Stop accepting NEW work: any later :meth:`submit` answers a
+        structured ``routing`` error at its reserved order instead of
+        queueing into a loop nobody will drive again (the multi-replica
+        router's redispatch path can race a draining replica in exactly
+        this window). Everything already queued or in flight keeps its
+        contract — the caller drives ``admit``/``step``/``drain_ready``
+        until ``busy`` clears, exactly as before."""
+        with self._intake_lock:
+            self._closed = True
 
     # ---- output -----------------------------------------------------------
 
