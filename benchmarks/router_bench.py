@@ -21,6 +21,11 @@ acceptance numbers:
 - **redispatch count** — with ``--kill`` (default when replicas > 1) one
   replica is SIGKILLed mid-workload: every accepted request must still
   answer (zero loss), and the row pins how many rode the failover path.
+- **time-to-heal** — with ``--heal`` (default) an extra soak runs the
+  2-replica fleet under a Supervisor, SIGKILLs one replica mid-run, and
+  rows the death-to-readmission seconds plus how many requests the
+  surviving fleet answered during the gap (the self-healing tier's
+  acceptance numbers, docs/SERVING.md "Self-healing fleet").
 """
 
 from __future__ import annotations
@@ -149,6 +154,79 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
     }
 
 
+def run_heal(args, spec_path: str) -> dict:
+    """The self-healing soak: 2 supervised replicas, SIGKILL one mid-run,
+    measure death -> readmission and what the gap cost."""
+    from transformer_tpu.serve.replica import build_model_from_spec
+    from transformer_tpu.serve.router import ReplicaProcess, Router
+    from transformer_tpu.serve.supervisor import Supervisor
+
+    _, _, tok = build_model_from_spec(SPEC)
+    worker = [
+        "--model_spec", spec_path,
+        "--serve_slots", str(args.slots),
+        "--prefix_cache_mb", "32",
+        "--prefix_block", str(args.prefix_block),
+        "--heartbeat_ms", "100",
+    ]
+    n_replicas = 2
+    links = [ReplicaProcess.spawn(i, list(worker)) for i in range(n_replicas)]
+
+    def spawn(index, name, role):
+        return ReplicaProcess.spawn(index, list(worker), role=role, name=name)
+
+    sup = Supervisor(spawn, backoff_ms=50.0)
+    router = Router(
+        links, encode=tok.encode, bos_id=tok.bos_id,
+        affinity_block=args.prefix_block, heartbeat_timeout_s=10.0,
+        supervisor=sup,
+    )
+    for link in links:
+        link.start_reader(router.inbox)
+
+    reqs = _workload(args.requests, n_replicas, args.system_words)
+    t0 = time.perf_counter()
+    for r in reqs:
+        router.submit(dict(r))
+    answered = []
+    killed = False
+    gap_served = 0
+    deadline = time.time() + 300
+    while (
+        router.busy or (killed and sup.stats["respawns"] < 1)
+    ) and time.time() < deadline:
+        router.pump()
+        fresh = router.drain_ready()
+        answered.extend(fresh)
+        if killed and sup.stats["respawns"] < 1:
+            # The gap: between the SIGKILL and the replacement's
+            # admission, the surviving fleet carries the whole workload.
+            gap_served += len(fresh)
+        if not killed and len(answered) >= args.requests // 4:
+            victim = max(router.links, key=lambda l: l.inflight)
+            if victim.inflight > 0:
+                os.kill(victim.pid(), signal.SIGKILL)
+                killed = True
+    answered.extend(router.drain_ready())
+    wall = time.perf_counter() - t0
+    router.shutdown()
+    heal_s = sup.heal_times[0] if sup.heal_times else None
+    return {
+        "mode": "heal",
+        "replicas": n_replicas,
+        "requests": len(reqs),
+        "answered": len(answered),
+        "answered_ok": sum(1 for a in answered if "continuation" in a),
+        "wall_s": round(wall, 3),
+        "killed_one": killed,
+        "time_to_heal_s": None if heal_s is None else round(heal_s, 3),
+        "served_during_gap": gap_served,
+        "warmed_tokens": sup.stats["warmed_tokens"],
+        "respawns": sup.stats["respawns"],
+        "redispatch_count": router.stats["redispatched"],
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replica_counts", type=str, default="1,2,4")
@@ -161,6 +239,11 @@ def main() -> None:
                    default=True,
                    help="SIGKILL one replica mid-workload (replicas > 1) "
                         "to pin the zero-loss failover numbers")
+    p.add_argument("--heal", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="run the supervised-respawn soak: SIGKILL one of "
+                        "2 supervised replicas mid-run and row the "
+                        "time-to-heal + requests served during the gap")
     p.add_argument("--rows_out", type=str, default="",
                    help="append bench_rows.jsonl-compatible rows here "
                         "('' = print them to stderr)")
@@ -201,6 +284,31 @@ def main() -> None:
                 "prefix_hit_rate_per_replica": hit_rates,
                 "redispatch_count": result["redispatch_count"],
                 "failovers": result["failovers"],
+                "device": device,
+                "vs_baseline": None,
+            }))
+        if args.heal:
+            result = run_heal(args, spec_path)
+            print(json.dumps(result))
+            assert result["answered"] == result["requests"], (
+                "heal soak lost requests"
+            )
+            assert result["respawns"] == 1, (
+                f"fleet did not heal: {result}"
+            )
+            rows.append(json.dumps({
+                "metric": "router time-to-heal",
+                "value": result["time_to_heal_s"],
+                "unit": "s",
+                "config": {
+                    "replicas": result["replicas"], "slots": args.slots,
+                    "requests": args.requests,
+                    "system_words": args.system_words,
+                    "prefix_block": args.prefix_block,
+                },
+                "served_during_gap": result["served_during_gap"],
+                "warmed_tokens": result["warmed_tokens"],
+                "redispatch_count": result["redispatch_count"],
                 "device": device,
                 "vs_baseline": None,
             }))

@@ -783,6 +783,7 @@ def test_schedules_canned_scenarios_clean():
     assert {r.name for r in results} == {
         "prefix_cache_contention", "registry_scrape_vs_create",
         "prefetch_shutdown", "eventlog_writers", "router_dispatch_tables",
+        "supervisor_respawn",
     }
 
 

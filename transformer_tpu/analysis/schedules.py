@@ -975,6 +975,135 @@ def _scenario_router_tables(sched: DetScheduler):
     return [client, pump, replica(0), replica(1)], check
 
 
+def _scenario_supervisor_respawn(sched: DetScheduler):
+    """The self-healing tier under adversarial interleaving: a CLIENT
+    submitting orders, the ROUTER pump (which also drives the supervisor's
+    respawn/warm state machine and the answer funnel), a SURVIVOR replica
+    feeding heartbeats/answers/warm-up exports, and the REPLACEMENT
+    worker the supervisor spawns mid-run. Replica 0 dies (EOF sentinel)
+    with work possibly in flight; the supervisor must re-bootstrap it
+    exactly once (no double-spawn no matter how poll/on_death/exit
+    interleave), warm it from the survivor, and admit it — while the
+    funnel answers every accepted order exactly once (no lost order
+    through the death -> failover -> respawn window)."""
+    from transformer_tpu.serve.router import ReplicaLink, Router
+    from transformer_tpu.serve.supervisor import Supervisor
+
+    class _Scripted(ReplicaLink):
+        def __init__(self, index, name, mailbox):
+            super().__init__(index, name)
+            self.mailbox = mailbox
+            self.ok = True
+
+        def send(self, msg):
+            self.mailbox.put(msg)
+
+        def alive(self):
+            return self.ok
+
+        def kill(self):
+            self.ok = False
+
+    mailboxes = [DetQueue(sched), DetQueue(sched)]
+    newbie_mailbox = DetQueue(sched)
+    links = [_Scripted(i, f"r{i}", mailboxes[i]) for i in range(2)]
+    spawn_calls: list = []
+
+    def spawn(index, name, role):
+        # The deterministic re-bootstrap recipe. Called on the router
+        # thread; the "process" announces ready through the inbox exactly
+        # like a real worker's bootstrap line.
+        spawn_calls.append(index)
+        link = _Scripted(index, name, newbie_mailbox)
+        router.inbox.put((index, {"type": "ready", "replica": name}))
+        return link
+
+    sup = Supervisor(
+        spawn, backoff_ms=0.0, boot_timeout_s=300.0, warm_timeout_s=300.0,
+    )
+    router = Router(
+        links, encode=lambda s: [3, 4, 5, 6, 7, 8, 9, 10], bos_id=1,
+        affinity_block=4, supervisor=sup,
+    )
+    N = 3
+    drained: list = []
+
+    def client():
+        for i in range(N):
+            router.submit({"prompt": f"p{i}"})
+        # Replica 0 dies with whatever the dispatcher already handed it.
+        links[0].ok = False
+        router.inbox.put((0, {"type": "exit"}))
+
+    def survivor():
+        while True:
+            msg = mailboxes[1].get()
+            kind = msg.get("type")
+            if kind == "shutdown":
+                return
+            if kind == "export_state":
+                # The warm-up export the supervisor asked for.
+                router.inbox.put((1, {
+                    "type": "prefix_state",
+                    "entries": [{"ids": [3, 4, 5, 6], "tokens": 7,
+                                 "blocks": []}],
+                }))
+                continue
+            rid = msg["rid"]
+            router.inbox.put(
+                (1, {"type": "hb", "backlog": 0, "free": 2, "active": 1})
+            )
+            router.inbox.put(
+                (1, {"type": "answer", "rid": rid,
+                     "resp": {"continuation": "s"}})
+            )
+
+    def newbie():
+        while True:
+            msg = newbie_mailbox.get()
+            kind = msg.get("type")
+            if kind == "shutdown":
+                return
+            if kind == "inject_state":
+                tokens = sum(
+                    int(e.get("tokens", 0)) for e in msg.get("entries", [])
+                )
+                router.inbox.put(
+                    (0, {"type": "state_injected", "tokens": tokens})
+                )
+                continue
+            if kind == "req":
+                router.inbox.put(
+                    (0, {"type": "answer", "rid": msg["rid"],
+                         "resp": {"continuation": "n"}})
+                )
+
+    def pump():
+        while len(drained) < N or sup.stats["respawns"] < 1:
+            router.pump(timeout=0.01)
+            drained.extend(router.drain_ready())
+        router.pump(timeout=0.01)
+        for mb in mailboxes:
+            mb.put({"type": "shutdown"})
+        newbie_mailbox.put({"type": "shutdown"})
+
+    def check():
+        assert len(drained) == N, f"orders lost/duplicated: {drained}"
+        assert all("error" not in d for d in drained), drained
+        assert len(spawn_calls) == 1, f"double-spawn: {spawn_calls}"
+        assert sup.stats["respawns"] == 1, sup.stats
+        assert sup.stats["warmed_tokens"] == 7, sup.stats
+        assert not router._inflight, "in-flight table leaked entries"
+        healthy = [
+            l for l in router.links
+            if not l.dead and not l.warming and not l.draining
+        ]
+        assert len(healthy) == 2, "fleet did not heal back to N"
+        assert sup._slots[0].phase == "up", sup._slots[0].phase
+
+    return [client, pump, survivor, newbie], check
+
+
 def _pkg_files(*modnames: str) -> list[str]:
     import importlib
 
@@ -1026,6 +1155,23 @@ CANNED: dict[str, Scenario] = {
         # 4 threads (client / router pump / 2 replicas): the tree is too
         # wide for bounded-exhaustive DFS — seeded-random distinct traces,
         # per the explorer's >2-thread policy.
+        max_schedules=24,
+        random_mode=True,
+    ),
+    "supervisor_respawn": Scenario(
+        name="supervisor_respawn",
+        setup=_scenario_supervisor_respawn,
+        modules=lambda: _pkg_modules(
+            "transformer_tpu.serve.router",
+            "transformer_tpu.serve.supervisor",
+        ),
+        instrument=lambda: _pkg_files(
+            "transformer_tpu.serve.router",
+            "transformer_tpu.serve.supervisor",
+        ),
+        # 4 threads (client / pump+supervisor / survivor / replacement):
+        # seeded-random distinct traces, per the explorer's >2-thread
+        # policy.
         max_schedules=24,
         random_mode=True,
     ),

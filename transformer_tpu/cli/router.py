@@ -22,6 +22,23 @@ With ``--metrics_jsonl=PATH`` the router logs to PATH and each replica to
 ``--disaggregate`` marks replica 0 prefill-only and the rest decode-only:
 prompts are ingested on the prefill side and their KV handed to decode
 replicas as prefix-cache blocks (docs/SERVING.md "Multi-replica router").
+
+**Self-healing fleet** (PR 11, docs/SERVING.md "Self-healing fleet"):
+``--supervise`` (default on) attaches a :class:`serve.supervisor.Supervisor`
+— a SIGKILLed replica is re-bootstrapped from the same deterministic
+recipe under its old name, its PrefixCache warmed from a survivor, with a
+bounded restart budget (``--max_restarts`` per ``--restart_window``).
+``--max_replicas N`` > the spawn count enables SLO-driven autoscaling:
+sustained ``ttft_p95`` burn > 1 grows the fleet, sustained idleness
+drains it back to ``--min_replicas``. ``--ha`` journals intake/delivery/
+heartbeat events to ``--metrics_jsonl`` and puts replicas on takeover
+control sockets so a warm standby::
+
+    python -m transformer_tpu.cli.router --standby PATH.jsonl ...
+
+can tail the log, detect primary death by heartbeat silence
+(``--takeover_after``), adopt the fleet, and answer every in-flight
+request exactly once (``serve/standby.py``).
 """
 
 from __future__ import annotations
@@ -79,6 +96,57 @@ def define_router_flags() -> None:
         "disaggregate", False,
         "prefill/decode disaggregation: replica 0 ingests prompts only and "
         "hands KV blocks to decode-only peers (docs/SERVING.md)")
+    # ---- self-healing fleet (serve/supervisor.py, serve/standby.py) ------
+    flags.DEFINE_boolean(
+        "supervise", True,
+        "supervised respawn: re-bootstrap dead replicas from the same "
+        "deterministic recipe under their old rendezvous name, warming "
+        "the replacement's PrefixCache from a survivor before admission")
+    flags.DEFINE_integer(
+        "max_restarts", 3,
+        "respawn budget per replica within --restart_window before the "
+        "supervisor gives up (breaker stays open, fleet serves at N-1)")
+    flags.DEFINE_float("restart_window", 120.0,
+                       "seconds over which --max_restarts is counted")
+    flags.DEFINE_float("spawn_backoff_ms", 200.0,
+                       "base exponential backoff between respawn attempts")
+    flags.DEFINE_integer(
+        "warm_prefixes", 8,
+        "hottest survivor PrefixCache prefixes exported to warm a "
+        "respawned replica (0 = admit cold)")
+    flags.DEFINE_integer(
+        "max_replicas", 0,
+        "SLO-driven autoscaling ceiling: > --replicas enables scale-up on "
+        "sustained ttft_p95 burn > 1 and idle drain back down "
+        "(0 = fixed fleet)")
+    flags.DEFINE_integer("min_replicas", 1, "autoscaling floor")
+    flags.DEFINE_string(
+        "scale_signal", "ttft_p95",
+        "the SLO whose burn rate drives scale-up (must name an objective "
+        "in --slo_spec / the defaults)")
+    flags.DEFINE_float("scale_sustain", 5.0,
+                       "seconds of sustained burn > 1 before a scale-up")
+    flags.DEFINE_float("scale_idle", 30.0,
+                       "seconds of sustained idleness before a drain")
+    flags.DEFINE_float("scale_cooldown", 15.0,
+                       "seconds between consecutive scaling decisions")
+    flags.DEFINE_string(
+        "slo_spec", "",
+        "SLO objectives for the router's own burn-rate engine (obs/slo.py "
+        "grammar; '' = defaults when autoscaling is on; 'none' disables)")
+    flags.DEFINE_boolean(
+        "ha", False,
+        "router HA primary: journal intake/delivery/heartbeat events to "
+        "--metrics_jsonl and give replicas takeover control sockets so a "
+        "warm standby (--standby) can adopt the fleet")
+    flags.DEFINE_string(
+        "standby", "",
+        "run as the warm STANDBY for the primary whose --metrics_jsonl is "
+        "this path: tail its journal, adopt the fleet when its heartbeat "
+        "goes silent, then serve from this process's stdin")
+    flags.DEFINE_float(
+        "takeover_after", 2.0,
+        "standby: seconds of primary heartbeat silence before takeover")
 
 
 def worker_args_from_flags(replica_jsonl: str = "") -> list[str]:
@@ -104,6 +172,8 @@ def worker_args_from_flags(replica_jsonl: str = "") -> list[str]:
         out += ["--metrics_jsonl", replica_jsonl]
         if FLAGS.trace:
             out += ["--trace"]
+    if FLAGS.ha or FLAGS.standby:
+        out += ["--ha"]
     return out
 
 
@@ -147,28 +217,167 @@ def route_lines(q: "queue.Queue", router) -> None:
             print(json.dumps(resp), flush=True)
 
 
+def _load_tokenizer():
+    # Affinity hashing needs only the tokenizer — the router never loads
+    # the model or compiles a program, so it restarts cheaply and
+    # survives replica OOMs.
+    from transformer_tpu.data.tokenizer import SubwordTokenizer
+
+    if FLAGS.model_spec:
+        with open(FLAGS.model_spec) as f:
+            spec = json.load(f)
+        return SubwordTokenizer.build_from_corpus(
+            list(spec["corpus"]),
+            target_vocab_size=int(spec.get("target_vocab_size", 300)),
+        )
+    return SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+
+
+def _spawn_recipe():
+    """The supervisor's deterministic re-bootstrap callable: the SAME
+    worker argv the original fleet used, under the replica's old name —
+    rendezvous hashing re-offers the replacement its predecessor's keys."""
+    from transformer_tpu.serve.router import ReplicaProcess
+
+    def spawn(index: int, name: str, role: str):
+        replica_jsonl = (
+            f"{FLAGS.metrics_jsonl}.r{index}" if FLAGS.metrics_jsonl else ""
+        )
+        return ReplicaProcess.spawn(
+            index, worker_args_from_flags(replica_jsonl), role=role,
+            name=name,
+        )
+
+    return spawn
+
+
+def _supervision_kwargs() -> dict:
+    """Supervisor / FleetScaler / SLO kwargs shared by the primary and an
+    adopting standby (the standby becomes a first-class primary)."""
+    from transformer_tpu.serve.supervisor import FleetScaler, Supervisor
+
+    out: dict = {}
+    if FLAGS.supervise:
+        out["supervisor"] = Supervisor(
+            _spawn_recipe(),
+            max_restarts=FLAGS.max_restarts,
+            restart_window_s=FLAGS.restart_window,
+            backoff_ms=FLAGS.spawn_backoff_ms,
+            warm_prefixes=FLAGS.warm_prefixes,
+        )
+    slo_spec = FLAGS.slo_spec
+    autoscale = FLAGS.supervise and FLAGS.max_replicas > 0
+    if slo_spec.lower() in ("none", "off"):
+        slo_spec = ""
+        autoscale = False
+    if autoscale:
+        out["scaler"] = FleetScaler(
+            signal=FLAGS.scale_signal,
+            sustain_s=FLAGS.scale_sustain,
+            idle_s=FLAGS.scale_idle,
+            max_replicas=FLAGS.max_replicas,
+            min_replicas=FLAGS.min_replicas,
+            cooldown_s=FLAGS.scale_cooldown,
+        )
+    if slo_spec:
+        out["slos"] = slo_spec
+    elif autoscale:
+        from transformer_tpu.obs.slo import DEFAULT_SLOS
+
+        out["slos"] = DEFAULT_SLOS
+    if autoscale:
+        # A watched signal missing from the objective set would pin the
+        # scale-up burn to 0 forever while idle drain kept working — a
+        # silently one-directional autoscaler. Fail loudly at startup.
+        from transformer_tpu.obs.slo import parse_slo_spec
+
+        specs = (
+            parse_slo_spec(out["slos"])
+            if isinstance(out["slos"], str) else out["slos"]
+        )
+        names = {s.name for s in specs}
+        if FLAGS.scale_signal not in names:
+            raise ValueError(
+                f"--scale_signal {FLAGS.scale_signal!r} is not among the "
+                f"SLO objectives {sorted(names)}; scale-up could never "
+                "trigger"
+            )
+    return out
+
+
+def _serve_stdin(router, telemetry) -> None:
+    from transformer_tpu.serve.replica import stdin_reader
+
+    q: queue.Queue = queue.Queue(
+        maxsize=max(1, FLAGS.serve_slots * max(1, len(router.links))) * 8
+    )
+    threading.Thread(target=stdin_reader, args=(q,), daemon=True).start()
+    try:
+        route_lines(q, router)
+    finally:
+        router.shutdown()
+        if telemetry is not None:
+            telemetry.close()
+
+
 def main(argv) -> None:
     del argv
     from transformer_tpu.cli.flags import flags_to_telemetry
     from transformer_tpu.serve.router import ReplicaProcess, Router
 
     telemetry = flags_to_telemetry()
-    # Affinity hashing needs only the tokenizer — the router never loads
-    # the model or compiles a program, so it restarts cheaply and
-    # survives replica OOMs.
-    if FLAGS.model_spec:
-        with open(FLAGS.model_spec) as f:
-            spec = json.load(f)
-        from transformer_tpu.data.tokenizer import SubwordTokenizer
+    tok = _load_tokenizer()
 
-        tok = SubwordTokenizer.build_from_corpus(
-            list(spec["corpus"]),
-            target_vocab_size=int(spec.get("target_vocab_size", 300)),
+    if FLAGS.standby:
+        # Warm standby: tail the primary's journal until its heartbeat
+        # goes silent, adopt the fleet, then serve from OUR stdin.
+        from transformer_tpu.serve.standby import Standby
+
+        if telemetry is None:
+            logging.warning(
+                "--standby without --metrics_jsonl: after adopting, this "
+                "router writes no journal — the NEXT standby will have "
+                "nothing to tail"
+            )
+
+        standby = Standby(
+            FLAGS.standby,
+            takeover_after_s=FLAGS.takeover_after,
+            encode=tok.encode,
+            bos_id=tok.bos_id,
+            telemetry=telemetry,
+            router_kwargs=dict(
+                affinity_block=FLAGS.affinity_block or FLAGS.prefix_block,
+                affinity_slack=FLAGS.affinity_slack,
+                max_redispatch=FLAGS.max_redispatch,
+                heartbeat_timeout_s=FLAGS.heartbeat_timeout,
+                **_supervision_kwargs(),
+            ),
         )
-    else:
-        from transformer_tpu.data.tokenizer import SubwordTokenizer
+        logging.info(
+            "standby up: tailing %s (takeover after %.1fs of silence)",
+            FLAGS.standby, FLAGS.takeover_after,
+        )
+        router = standby.run_until_takeover()
+        logging.info(
+            "adopted the fleet as epoch %d: %s", router.epoch,
+            standby.stats,
+        )
+        _serve_stdin(router, telemetry)
+        return
 
-        tok = SubwordTokenizer.load(FLAGS.tgt_vocab_file)
+    ha = FLAGS.ha
+    if ha and telemetry is None:
+        # The HA journal IS the event log — a standby cannot adopt what
+        # was never written. Warn like --trace does, don't silently no-op.
+        # Write the decision back into FLAGS so the worker argv agrees:
+        # a worker spawned with --ha would survive this router's death as
+        # a permanent orphan no standby could ever find.
+        logging.warning(
+            "--ha needs --metrics_jsonl for the standby journal; disabling"
+        )
+        ha = False
+        FLAGS.ha = False
 
     n = max(1, FLAGS.replicas)
     links = []
@@ -194,25 +403,19 @@ def main(argv) -> None:
         heartbeat_timeout_s=FLAGS.heartbeat_timeout,
         disaggregate=FLAGS.disaggregate,
         telemetry=telemetry,
+        ha=ha,
+        **_supervision_kwargs(),
     )
     for link in links:
         link.start_reader(router.inbox)
     logging.info(
-        "router up: %d replica(s) x %d slots, affinity block %d%s",
+        "router up: %d replica(s) x %d slots, affinity block %d%s%s%s",
         n, FLAGS.serve_slots, FLAGS.affinity_block or FLAGS.prefix_block,
         ", disaggregated prefill/decode" if FLAGS.disaggregate else "",
+        ", supervised" if FLAGS.supervise else "",
+        ", HA journal on" if ha else "",
     )
-
-    from transformer_tpu.serve.replica import stdin_reader
-
-    q: queue.Queue = queue.Queue(maxsize=max(1, FLAGS.serve_slots * n) * 8)
-    threading.Thread(target=stdin_reader, args=(q,), daemon=True).start()
-    try:
-        route_lines(q, router)
-    finally:
-        router.shutdown()
-        if telemetry is not None:
-            telemetry.close()
+    _serve_stdin(router, telemetry)
 
 
 def run() -> None:

@@ -186,6 +186,56 @@ def summarize_events(events: list[dict]) -> dict:
             },
         }
 
+    # ---- fleet: supervision / autoscaling / router HA ---------------------
+    spawns = [e for e in events if e.get("kind") == "route.spawn"]
+    retires = [e for e in events if e.get("kind") == "route.retire"]
+    scales = [e for e in events if e.get("kind") == "route.scale"]
+    takeovers = [e for e in events if e.get("kind") == "route.takeover"]
+    if spawns or retires or scales or takeovers:
+        heals = [
+            e["heal_s"] for e in spawns
+            if isinstance(e.get("heal_s"), (int, float))
+        ]
+        fleet: dict = {
+            "respawns": sum(
+                1 for e in spawns
+                if not e.get("gave_up") and not e.get("scale_up")
+            ),
+            "gave_up": sum(1 for e in spawns if e.get("gave_up")),
+            "warmed_tokens": sum(
+                int(e.get("warmed_tokens", 0) or 0) for e in spawns
+            ),
+            "scale_ups": sum(
+                1 for e in scales if e.get("direction") == "up"
+            ),
+            "scale_downs": sum(
+                1 for e in scales if e.get("direction") == "down"
+            ),
+            "retired": len(retires),
+            "takeovers": len(takeovers),
+        }
+        if heals:
+            fleet["time_to_heal_s"] = {
+                "count": len(heals),
+                "mean": round(sum(heals) / len(heals), 6),
+                "max": round(max(heals), 6),
+            }
+        if scales:
+            last = scales[-1]
+            fleet["final_fleet_size"] = last.get("fleet_size")
+            fleet["last_scale_evidence"] = last.get("evidence")
+        if takeovers:
+            t = takeovers[-1]
+            fleet["takeover"] = {
+                k: t.get(k)
+                for k in (
+                    "epoch", "adopted", "failed", "recovered_answers",
+                    "reowned_inflight", "redispatched", "delivered_upto",
+                )
+                if t.get(k) is not None
+            }
+        report["fleet"] = fleet
+
     # ---- serve: grouped-path batches --------------------------------------
     batches = [e for e in events if e.get("kind") == "serve.batch"]
     if batches:
@@ -421,6 +471,42 @@ def render_text(report: dict) -> str:
                 if rep.get("share") is not None else ""
             )
             lines.append(f"  {name}: {rep['requests']} requests{share}")
+    fleet = report.get("fleet")
+    if fleet:
+        parts = []
+        if fleet.get("respawns"):
+            h = fleet.get("time_to_heal_s")
+            heal = (
+                f" (time-to-heal mean {_fmt_s(h['mean'])}, "
+                f"max {_fmt_s(h['max'])})" if h else ""
+            )
+            parts.append(f"{fleet['respawns']} respawn(s){heal}")
+        if fleet.get("warmed_tokens"):
+            parts.append(f"{fleet['warmed_tokens']} cache tokens warmed")
+        if fleet.get("gave_up"):
+            parts.append(f"{fleet['gave_up']} crash-loop give-up(s)")
+        if fleet.get("scale_ups") or fleet.get("scale_downs"):
+            part = (
+                f"scaled up x{fleet['scale_ups']}, "
+                f"down x{fleet['scale_downs']}"
+            )
+            if fleet.get("final_fleet_size") is not None:
+                part += f" (final fleet {fleet['final_fleet_size']})"
+            parts.append(part)
+        if fleet.get("retired"):
+            parts.append(f"{fleet['retired']} retired")
+        if fleet.get("takeovers"):
+            t = fleet.get("takeover", {})
+            part = f"{fleet['takeovers']} router takeover(s)"
+            if t:
+                part += (
+                    f" [epoch {t.get('epoch')}: "
+                    f"{t.get('recovered_answers', 0)} recovered, "
+                    f"{t.get('reowned_inflight', 0)} re-owned, "
+                    f"{t.get('redispatched', 0)} re-dispatched]"
+                )
+            parts.append(part)
+        lines.append("fleet: " + "; ".join(parts))
     grouped = report.get("serve_grouped")
     if grouped:
         line = (

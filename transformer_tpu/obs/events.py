@@ -25,6 +25,24 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
   breaker revivals of heartbeat-timeout victims; ``obs summarize
   --merge`` reports per-replica request share and redispatches from
   these.
+- ``route.spawn`` / ``route.retire`` / ``route.scale`` — the supervision
+  tier (``serve/supervisor.py``): replica (re)spawn admissions
+  (``heal_s`` death-to-admitted, ``warmed_tokens`` prefix-cache warm-up;
+  ``gave_up=true`` when a crash loop exhausts its restart budget),
+  drain-and-retire completions, and every autoscaling decision with the
+  SLO burn-rate evidence window that justified it (``direction``,
+  ``signal``, ``burn_rate``, ``evidence``). ``obs summarize --merge``
+  renders the fleet section from these.
+- ``route.intake`` / ``route.answered`` / ``route.hb`` — the primary
+  router's HA journal (``--ha``; ``serve/standby.py`` tails these): one
+  replayable intake record per accepted order (request, traceparent,
+  remaining deadline budget), delivery marks from ``drain_ready``, and
+  the periodic liveness beacon (authority ``epoch``, replica control
+  ``ports``). An adopting router re-journals the orders it adopted, so
+  chained takeovers replay from its log alone.
+- ``route.takeover`` — emitted once by an adopting standby: the new
+  ``epoch``, adopted/failed replicas, and how every undelivered order
+  was resolved (recovered / re-owned / re-dispatched).
 - ``metrics.snapshot`` — periodic full registry dump (histograms as
   count/sum/min/max/p50/p95/p99).
 - ``bench.relay_probe`` / ``bench.fallback_row`` / ``bench.attempt`` —

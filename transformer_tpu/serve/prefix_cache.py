@@ -439,6 +439,27 @@ class PrefixCache:
             evicted += 1
         return evicted
 
+    def hot_prefixes(self, limit: int = 8) -> "list[tuple[int, ...]]":
+        """The ``limit`` most-recently-used maximal stored prefixes, as
+        token-id tuples (each a whole root-to-leaf block path) — the
+        supervisor's warm-from-a-survivor export surface (serve/replica.py
+        ``export_state``): injecting a leaf path stores every interior
+        block along it, so leaves alone cover the whole trie. Recency is
+        the LEAF's ``last_used`` (the same clock eviction consults). Read-
+        only under the lock; the actual block payloads are read later via
+        :meth:`match`, which re-verifies checksums and pins as usual."""
+        leaves: list[tuple[int, tuple[int, ...]]] = []
+        with self._lock:
+            stack = [(self._root, ())]
+            while stack:
+                node, path = stack.pop()
+                if node.blocks is not None and not node.children:
+                    leaves.append((node.last_used, path))
+                for child in node.children.values():
+                    stack.append((child, path + child.edge))
+        leaves.sort(key=lambda t: -t[0])
+        return [path for _, path in leaves[: max(0, limit)]]
+
     # ---- introspection ----------------------------------------------------
 
     @property

@@ -23,15 +23,55 @@ router -> replica:
                                                           prompt, export its
                                                           KV blocks, answer
                                                           "prefilled"
+    {"type": "export_state", "limit": K}                  supervisor warm-up:
+                                                          export the K hottest
+                                                          PrefixCache prefixes
+    {"type": "inject_state", "entries": [...]}            ...inject them into
+                                                          a fresh replica
     {"type": "shutdown"}                                  drain + exit
 
 replica -> router:
-    {"type": "ready", "replica": name, "slots": N}
+    {"type": "ready", "replica": name, "slots": N
+     [, "control_port": P]}                               P with --ha only
     {"type": "hb", "backlog": B, "free": F, "active": A}  heartbeat (the
                                                           least-loaded gauges)
-    {"type": "answer", "rid": N, "resp": {...}}           one per request
+    {"type": "answer", "rid": N, "resp": {...}
+     [, "slo": {...}]}                                    one per request;
+                                                          "slo" is the span
+                                                          side channel (ttft
+                                                          etc., stripped
+                                                          before the client)
     {"type": "prefilled", "rid": N, "tokens": T, "blocks": ...}
+    {"type": "prefix_state", "entries": [...]}            export_state reply
+    {"type": "state_injected", "tokens": T}               inject_state reply
     {"type": "stats", "stats": {...}}                     final, at shutdown
+
+**Router HA** (``--ha``): the worker additionally listens on a localhost
+TCP control socket (ephemeral port, announced in ``ready``). A warm-standby
+router (``serve/standby.py``) that declares the primary dead connects and
+sends a takeover handshake::
+
+    {"type": "takeover", "epoch": E, "inflight": [rid, ...]}
+
+An epoch HIGHER than the channel currently holding authority (stdin starts
+at epoch 1) wins: the reply reports, for every rid the standby believes
+in-flight here, whether it is ``done`` (with the original answer message
+replayed from a bounded recent-answer cache — an answer lost in the dead
+primary's pipe is re-delivered, and the standby's order-keyed funnel keeps
+at-most-once), still ``inflight`` (it will answer on the NEW channel), or
+``unknown`` (the standby re-dispatches it)::
+
+    {"type": "adopted", "replica": name, "epoch": E,
+     "statuses": {rid: "done"|"inflight"|"unknown"},
+     "messages": {rid: <original answer/prefilled message>}}
+
+and every subsequent worker message flows to the adopting channel. A
+takeover with a stale epoch answers ``{"type": "rejected", "epoch": cur}``
+and changes nothing — the split-brain guard: after an adoption, requests
+still arriving from the OLD channel (a falsely-declared-dead primary) are
+dropped and counted, never served twice. In HA mode stdin EOF does NOT
+drain the worker (the primary dying must not kill the fleet); shutdown
+comes from the authoritative channel (or the supervising process group).
 
 ``rid`` is the ROUTER's order for the request — the replica never invents
 identity, so the router's order-keyed answer funnel stays authoritative.
@@ -62,16 +102,38 @@ import argparse
 import base64
 import json
 import queue
+import socket
 import sys
 import threading
 import time
+from collections import deque
 
 
-def _msg_out(msg: dict) -> None:
-    """One protocol line on stdout. The main loop is the only writer, so
-    lines are never torn; flush per line — the router reads a pipe."""
-    sys.stdout.write(json.dumps(msg) + "\n")
-    sys.stdout.flush()
+class _Channel:
+    """One duplex control link: stdin/stdout, or an accepted takeover
+    socket. The MAIN loop is the only writer (lines never tear); reader
+    threads only parse the inbound side into the main queue. ``epoch`` is
+    the authority the channel last proved (stdin starts at 1; takeover
+    sockets earn theirs through the handshake); a write failure marks the
+    channel broken — answers are NOT lost with it, the bounded recent-
+    answer cache re-delivers them to whoever adopts next."""
+
+    def __init__(self, write_file, name: str, epoch: int = 0):
+        self._write = write_file
+        self.name = name
+        self.epoch = epoch
+        self.broken = False
+
+    def send(self, msg: dict) -> bool:
+        if self.broken or self._write is None:
+            return False
+        try:
+            self._write.write(json.dumps(msg) + "\n")
+            self._write.flush()
+            return True
+        except (OSError, ValueError):
+            self.broken = True
+            return False
 
 
 # --------------------------------------------------------------------------
@@ -209,6 +271,11 @@ def _parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--metrics_jsonl", default="")
     p.add_argument("--trace", action="store_true")
     p.add_argument("--fault_spec", default="")
+    p.add_argument("--ha", action="store_true",
+                   help="router HA: listen on a localhost control socket "
+                        "for a warm standby's takeover handshake, and "
+                        "survive stdin EOF (the primary dying must not "
+                        "kill the fleet)")
     return p.parse_args(argv)
 
 
@@ -220,6 +287,36 @@ def stdin_reader(q: "queue.Queue") -> None:
     for line in sys.stdin:
         q.put(line)
     q.put(None)
+
+
+def _control_server(listener: socket.socket, q: "queue.Queue") -> None:
+    """Accept takeover connections; per connection, one reader thread
+    feeds parsed ``(channel, line)`` pairs into the main queue — exactly
+    the stdin_reader contract, so the main loop stays the only owner of
+    every piece of serving state (the TPA101 surface between the control
+    threads and the loop is the synchronized queue alone)."""
+    while True:
+        try:
+            conn, _ = listener.accept()
+        except OSError:
+            return  # listener closed at shutdown
+        chan = _Channel(
+            conn.makefile("w", encoding="utf-8", buffering=1),
+            name="takeover",
+        )
+        rf = conn.makefile("r", encoding="utf-8")
+
+        def reader(chan=chan, rf=rf):
+            try:
+                for line in rf:
+                    q.put((chan, line))
+            except (OSError, ValueError):
+                pass
+            chan.broken = True
+
+        threading.Thread(
+            target=reader, daemon=True, name="replica-control-read"
+        ).start()
 
 
 def main(argv=None) -> None:
@@ -263,6 +360,12 @@ def main(argv=None) -> None:
             block_tokens=args.prefix_block,
             budget_mb=max(1, args.prefix_cache_mb or 64),
         )
+    # Span side channel: the scheduler hands every answer-boundary span
+    # dict to this tap (host-side, jaxpr-inert); flush_answers ships the
+    # latency/prefix numbers next to the answer so the ROUTER's SLO engine
+    # (the autoscaling signal) sees real per-request ttft without each
+    # replica needing its own telemetry sink.
+    spans_by_order: "dict[int, dict]" = {}
     sched = ContinuousScheduler(
         params, cfg, tok,
         num_slots=args.serve_slots,
@@ -273,30 +376,137 @@ def main(argv=None) -> None:
         speculate_k=args.speculate_k,
         prefix_cache=prefix_cache,
         max_backlog=args.max_backlog,
+        span_tap=lambda span: spans_by_order.__setitem__(
+            span.get("order"), span
+        ),
     )
 
     q: queue.Queue = queue.Queue()
     threading.Thread(target=stdin_reader, args=(q,), daemon=True).start()
-    _msg_out({
+    stdin_chan = _Channel(sys.stdout, "stdin", epoch=1)
+    epoch = 1
+    out = stdin_chan  # the authoritative outbound channel
+    control_port = None
+    if args.ha:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(4)
+        control_port = listener.getsockname()[1]
+        threading.Thread(
+            target=_control_server, args=(listener, q), daemon=True,
+            name="replica-control-accept",
+        ).start()
+    ready = {
         "type": "ready", "replica": args.replica_name,
         "slots": args.serve_slots, "role": args.role,
-    })
+    }
+    if control_port is not None:
+        ready["control_port"] = control_port
+    out.send(ready)
 
     hb_s = max(args.heartbeat_ms, 1.0) / 1e3
     last_hb = 0.0
     # rid bookkeeping: the scheduler answers in arrival order and this
-    # loop is the only submitter, so a FIFO of rids (parallel to the
-    # submission sequence) maps drained responses back to router orders.
-    rid_fifo: "list[int]" = []
+    # loop is the only submitter, so a FIFO of (rid, scheduler order)
+    # (parallel to the submission sequence) maps drained responses back to
+    # router orders and their tapped spans.
+    rid_fifo: "list[tuple[int, int]]" = []
     prefill_rids: "set[int]" = set()
     prompt_ids: "dict[int, list[int]]" = {}
+    # Bounded re-delivery cache: the full outbound message per answered
+    # rid, replayed through the takeover handshake when an answer died in
+    # the old primary's pipe (the adopting funnel dedupes, so replaying is
+    # always safe).
+    recent_answers: "dict[int, dict]" = {}
+    answer_fifo: deque = deque()
+    stats_extra = {"stale_dropped": 0, "takeovers": 0, "rejected_takeovers": 0}
 
-    def ingest(msg: dict) -> bool:
+    def _remember(rid, msg) -> None:
+        recent_answers[rid] = msg
+        answer_fifo.append(rid)
+        while len(answer_fifo) > 512:
+            recent_answers.pop(answer_fifo.popleft(), None)
+
+    def handle_takeover(chan: _Channel, msg: dict) -> None:
+        nonlocal epoch, out
+        e = int(msg.get("epoch", 0))
+        if e <= epoch:
+            # Split-brain guard: a stale or duplicate adopter changes
+            # nothing — the current authority keeps the worker.
+            stats_extra["rejected_takeovers"] += 1
+            chan.send({
+                "type": "rejected", "replica": args.replica_name,
+                "epoch": epoch,
+            })
+            return
+        statuses: dict = {}
+        messages: dict = {}
+        inflight_here = {rid for rid, _ in rid_fifo}
+        for rid in msg.get("inflight", []):
+            if rid in recent_answers:
+                statuses[str(rid)] = "done"
+                messages[str(rid)] = recent_answers[rid]
+            elif rid in inflight_here:
+                statuses[str(rid)] = "inflight"
+            else:
+                statuses[str(rid)] = "unknown"
+        epoch = e
+        chan.epoch = e
+        out = chan
+        stats_extra["takeovers"] += 1
+        out.send({
+            "type": "adopted", "replica": args.replica_name, "epoch": e,
+            "role": args.role, "slots": args.serve_slots,
+            "statuses": statuses, "messages": messages,
+            "backlog": sched.backlog, "active": sched.active_count,
+        })
+
+    def ingest(chan: _Channel, msg: dict) -> bool:
         """Handle one control message; returns False on shutdown."""
         kind = msg.get("type")
+        if kind == "takeover":
+            handle_takeover(chan, msg)
+            return True
+        if chan.epoch < epoch:
+            # A channel that lost authority (the falsely-declared-dead
+            # primary of a completed takeover): its requests must not be
+            # served TWICE — drop and count.
+            stats_extra["stale_dropped"] += 1
+            return True
         if kind == "shutdown":
             sched.shutdown()
             return False
+        if kind == "export_state":
+            entries = []
+            if prefix_cache is not None:
+                for ids in prefix_cache.hot_prefixes(
+                    int(msg.get("limit", 8))
+                ):
+                    try:
+                        tokens, payload = export_blocks(
+                            prefix_cache, list(ids)
+                        )
+                    except Exception:  # tpa: disable=TPA006 — warm-up export is best-effort: a corrupt/evicted prefix is skipped, the newcomer just starts colder
+                        continue
+                    if tokens:
+                        entries.append({
+                            "ids": list(ids), "tokens": tokens,
+                            "blocks": payload,
+                        })
+            out.send({"type": "prefix_state", "entries": entries})
+            return True
+        if kind == "inject_state":
+            total = 0
+            for e in msg.get("entries", []):
+                try:
+                    total += inject_blocks(
+                        prefix_cache, list(e["ids"]), e.get("tokens", 0),
+                        e.get("blocks", []),
+                    ) if prefix_cache is not None else 0
+                except Exception:  # tpa: disable=TPA006 — a corrupt warm-up payload degrades to a cold cache, never a dead worker
+                    pass
+            out.send({"type": "state_injected", "tokens": total})
+            return True
         if kind not in ("req", "prefill"):
             return True
         rid = msg.get("rid")
@@ -322,13 +532,14 @@ def main(argv=None) -> None:
                     )
                 except Exception:  # tpa: disable=TPA006 — a corrupt handoff payload degrades to full prefill (the cache just misses); it must never kill the worker
                     pass
-        sched.submit(req)
-        rid_fifo.append(rid)
+        order = sched.submit(req)
+        rid_fifo.append((rid, order))
         return True
 
     def flush_answers() -> None:
         for resp in sched.drain_ready():
-            rid = rid_fifo.pop(0)
+            rid, order = rid_fifo.pop(0)
+            span = spans_by_order.pop(order, None)
             if rid in prefill_rids:
                 prefill_rids.discard(rid)
                 tokens, payload = 0, []
@@ -338,13 +549,27 @@ def main(argv=None) -> None:
                         tokens, payload = export_blocks(prefix_cache, ids)
                     except Exception:  # tpa: disable=TPA006 — export is best-effort: a failed handoff falls back to full prefill on the decode side
                         tokens, payload = 0, []
-                _msg_out({
+                msg = {
                     "type": "prefilled", "rid": rid,
                     "tokens": tokens, "blocks": payload,
-                })
+                }
             else:
                 prompt_ids.pop(rid, None)
-                _msg_out({"type": "answer", "rid": rid, "resp": resp})
+                msg = {"type": "answer", "rid": rid, "resp": resp}
+                if span is not None:
+                    # The side channel the router's SLO engine feeds on —
+                    # never merged into resp (client answers stay
+                    # byte-identical to a single scheduler's).
+                    msg["slo"] = {
+                        k: span[k]
+                        for k in (
+                            "ttft_s", "queue_s", "total_s",
+                            "prefix_hit_tokens", "new_tokens",
+                        )
+                        if k in span
+                    }
+            _remember(rid, msg)
+            out.send(msg)
 
     alive = True
     while alive or sched.busy:
@@ -352,16 +577,25 @@ def main(argv=None) -> None:
         while alive:
             try:
                 if sched.busy or sched.has_ready:
-                    line = q.get_nowait()
+                    item = q.get_nowait()
                 else:
                     # Idle: block, but wake often enough that heartbeats
                     # keep flowing (the router's liveness gauge).
-                    line = q.get(timeout=hb_s)
+                    item = q.get(timeout=hb_s)
             except queue.Empty:
                 break
-            if line is None:
-                alive = False
-                break
+            if item is None:
+                # stdin EOF: in HA mode the worker outlives its primary —
+                # a standby adopts through the control socket; without HA
+                # the historical drain-and-exit contract holds.
+                if not args.ha:
+                    alive = False
+                    break
+                continue
+            if isinstance(item, str):
+                chan, line = stdin_chan, item
+            else:
+                chan, line = item
             line = line.strip()
             if not line:
                 continue
@@ -369,7 +603,9 @@ def main(argv=None) -> None:
                 msg = json.loads(line)
             except ValueError:
                 continue
-            if not ingest(msg):
+            if not isinstance(msg, dict):
+                continue
+            if not ingest(chan, msg):
                 alive = False
                 break
         sched.admit()
@@ -379,14 +615,14 @@ def main(argv=None) -> None:
         now = time.monotonic()
         if now - last_hb >= hb_s:
             last_hb = now
-            _msg_out({
+            out.send({
                 "type": "hb",
                 "backlog": sched.backlog,
                 "free": sched.num_slots - sched.active_count,
                 "active": sched.active_count,
             })
     flush_answers()
-    _msg_out({"type": "stats", "stats": dict(sched.stats)})
+    out.send({"type": "stats", "stats": {**dict(sched.stats), **stats_extra}})
     if telemetry is not None:
         telemetry.close()
 

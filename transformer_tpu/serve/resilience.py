@@ -74,6 +74,13 @@ FAULT_POINTS = frozenset({
     "obs.emit",         # raise inside EventLog.emit's write
     "ckpt.write",       # raise inside CheckpointManager._commit (pre-rename)
     "data.prefetch",    # raise inside the prefetch worker, before device_put
+    # Router-tier points (the supervision/HA drill surface, PR 11):
+    "route.spawn",      # raise inside the supervisor's replica (re)spawn —
+    #                     a crash-looping bootstrap, deterministically
+    "route.hb",         # swallow a replica heartbeat at the router —
+    #                     heartbeat-loss/failover storms without real stalls
+    "route.takeover",   # raise inside the standby's per-replica takeover
+    #                     handshake — partial adoptions + split-brain drills
 })
 
 

@@ -88,7 +88,12 @@ import time
 from collections import deque
 
 from transformer_tpu.obs.trace import SpanContext
-from transformer_tpu.serve.resilience import CircuitBreaker, error_answer
+from transformer_tpu.serve.resilience import (
+    CircuitBreaker,
+    InjectedFault,
+    error_answer,
+    maybe_fail,
+)
 
 
 def affinity_key(ids, block: int) -> "int | None":
@@ -201,6 +206,14 @@ class ReplicaLink:
         self.died_at: float | None = None  # monotonic death mark: only a
         #                                    heartbeat NEWER than this can
         #                                    revive the link
+        # Supervision states (serve/supervisor.py): a warming replacement
+        # is bootstrapping/cache-warming and takes no traffic yet; a
+        # draining victim finishes its in-flight work, then retires for
+        # good (retired links are never respawned or revived).
+        self.warming = False
+        self.draining = False
+        self.retired = False
+        self.control_port: int | None = None  # --ha takeover socket
         self.final_stats: dict | None = None  # replica's shutdown report
 
     # -- transport surface (overridden by real links) -----------------------
@@ -216,6 +229,10 @@ class ReplicaLink:
 
     def close(self) -> None:
         pass
+
+    def kill(self) -> None:
+        """Force the worker down (supervisor slot reclaim); transports
+        without a process are a no-op."""
 
     def serves(self, stage: str) -> bool:
         return self.role == "both" or self.role == stage
@@ -266,7 +283,10 @@ class ReplicaProcess(ReplicaLink):
                 continue  # torn final line of a dying replica
             if isinstance(msg, dict):
                 inbox.put((self.index, msg))
-        inbox.put((self.index, {"type": "exit"}))
+        # The pid stamps the sentinel so a supervisor-respawned REPLACEMENT
+        # at this index is never failed over by its predecessor's EOF (the
+        # old reader thread can outlive the link swap).
+        inbox.put((self.index, {"type": "exit", "pid": self._proc.pid}))
 
     def send(self, msg: dict) -> None:
         stdin = self._proc.stdin
@@ -280,6 +300,10 @@ class ReplicaProcess(ReplicaLink):
 
     def pid(self) -> int:
         return self._proc.pid
+
+    def kill(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.kill()
 
     def close(self, timeout: float = 10.0) -> None:
         try:
@@ -319,6 +343,12 @@ class Router:
         breaker_cooldown_s: float = 30.0,
         disaggregate: bool = False,
         telemetry=None,
+        supervisor=None,
+        scaler=None,
+        slos=None,
+        ha: bool = False,
+        epoch: int = 1,
+        ha_heartbeat_s: float = 0.5,
     ):
         if not links:
             raise ValueError("router needs at least one replica link")
@@ -354,6 +384,8 @@ class Router:
         # Per-replica breakers: a death/timeout opens the breaker so the
         # dispatcher stops offering traffic; a half-open probe after the
         # cooldown lets a recovered link earn its way back.
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
         self.breakers = [
             CircuitBreaker(
                 f"replica_{l.name}", threshold=breaker_threshold,
@@ -365,8 +397,39 @@ class Router:
             "submitted": 0, "dispatched": 0, "redispatched": 0,
             "answered": 0, "failovers": 0, "revivals": 0,
             "duplicate_answers": 0, "expired": 0, "exhausted": 0,
-            "no_replica": 0, "prefill_handoffs": 0,
+            "no_replica": 0, "prefill_handoffs": 0, "dropped_heartbeats": 0,
         }
+        # ---- supervision / autoscaling / HA (serve/supervisor.py,
+        # serve/standby.py; docs/SERVING.md "Self-healing fleet") ----------
+        self._sup = supervisor
+        self._scaler = scaler
+        self.ha = ha
+        self.epoch = epoch
+        self.ha_heartbeat_s = ha_heartbeat_s
+        self._last_ha_hb = 0.0
+        # The router's OWN SLO engine over the answer funnel: replicas ship
+        # per-answer latency in the "slo" side channel, the funnel records
+        # it here, and the FleetScaler consumes the burn gauges — the PR 9
+        # engine driving fleet size, as the ROADMAP elasticity item asks.
+        self._slo_engine = None
+        if slos is not None and hasattr(slos, "maybe_evaluate"):
+            # A prebuilt SLOEngine (tests pin the clock/interval; the
+            # standby hands over its own engine across the cutover).
+            self._slo_engine = slos
+        elif slos:
+            from transformer_tpu.obs.slo import SLOEngine, parse_slo_spec
+
+            specs = (
+                parse_slo_spec(slos) if isinstance(slos, str) else tuple(slos)
+            )
+            if specs:
+                self._slo_engine = SLOEngine(
+                    specs,
+                    registry=(
+                        telemetry.registry if telemetry is not None else None
+                    ),
+                    emit=telemetry.emit if telemetry is not None else None,
+                )
         # submit -> first dispatch; bounded (the bench reads it — the
         # serve-forever process must not grow a list per request when the
         # same data lives in the router_queue_seconds histogram).
@@ -392,6 +455,16 @@ class Router:
             self._m_replicas = reg.gauge(
                 "router_replicas_live", "replica links currently usable")
             self._m_replicas.set(len(links))
+            self._m_fleet = reg.gauge(
+                "route_fleet_size",
+                "healthy serving replicas (live, admitted, not draining)")
+            self._m_fleet.set(len(links))
+        if supervisor is not None:
+            supervisor.attach(self)
+        if scaler is not None:
+            if supervisor is None:
+                raise ValueError("a FleetScaler needs a Supervisor to act")
+            scaler.bind(self, supervisor)
 
     # ---- client intake (any thread) ---------------------------------------
 
@@ -435,6 +508,19 @@ class Router:
                     span_root=span_root,
                 )
             )
+        if self.ha:
+            # The standby's replayable intake record: enough to re-own (or
+            # re-dispatch) this order after adopting the fleet — the
+            # request itself, its trace identity, and its remaining
+            # deadline budget (serve/standby.py).
+            self.emit_event(
+                "route.intake", order=order, req=req,
+                traceparent=ctx.to_traceparent(),
+                deadline_ms=(
+                    None if deadline is None
+                    else round((deadline - now) * 1e3, 3)
+                ),
+            )
         return order
 
     def submit_done(self, resp: dict) -> int:
@@ -445,6 +531,10 @@ class Router:
             self._next_order += 1
             self.stats["submitted"] += 1
             self._done[order] = resp
+        if self.ha:
+            # Pre-answered orders carry their response in the intake
+            # record: the standby re-answers them from the log alone.
+            self.emit_event("route.intake", order=order, resp=resp)
         if self._tracer is not None:
             span = self._tracer.start_span("route.request", lane="router")
             extra = {}
@@ -459,9 +549,19 @@ class Router:
         """Responses completed in arrival order (the stdout contract)."""
         out = []
         with self._intake_lock:
+            first = self._emit_next
             while self._emit_next in self._done:
                 out.append(self._done.pop(self._emit_next))
                 self._emit_next += 1
+            last = self._emit_next
+        if self.ha and out:
+            # Delivery marks, not completion marks: an answer sitting
+            # out-of-order in _done died with this process — the standby
+            # recovers it from the replicas' re-delivery caches, while
+            # DELIVERED orders must never reach the client twice.
+            self.emit_event(
+                "route.answered", first=first, upto=last - 1, n=len(out)
+            )
         return out
 
     @property
@@ -490,6 +590,19 @@ class Router:
         progressed = self._drain_inbox(timeout)
         self._sweep_liveness()
         progressed |= self._dispatch_pending()
+        # Supervision tier (serve/supervisor.py): advance respawn/warm
+        # state machines, ship shutdowns to drained retirees, then let the
+        # scaling policy consume the freshest SLO burn evaluation.
+        if self._sup is not None:
+            progressed |= self._sup.poll()
+            progressed |= self._sup.reap_draining()
+        slo_result = None
+        if self._slo_engine is not None:
+            slo_result = self._slo_engine.maybe_evaluate()
+        if self._scaler is not None:
+            progressed |= self._scaler.poll(slo_result)
+        if self.ha:
+            self._ha_heartbeat()
         return progressed
 
     def run(self, reqs: "list[dict]") -> "list[dict]":
@@ -513,6 +626,146 @@ class Router:
             link.close()
         if self._tel is not None:
             self._tel.maybe_flush(force=True)
+
+    # -- fleet management (serve/supervisor.py, router thread) ---------------
+
+    def emit_event(self, kind: str, **fields) -> None:
+        """Telemetry-gated event emission — the supervision tier's one
+        outlet (``route.spawn`` / ``route.retire`` / ``route.scale`` / ...),
+        shared so fakes in tests can observe through a real EventLog."""
+        if self._tel is not None:
+            self._tel.emit(kind, **fields)
+
+    def replace_link(self, index: int, link: ReplicaLink) -> None:
+        """Swap a respawned replacement in UNDER ITS PREDECESSOR'S index
+        and name — rendezvous hashing therefore re-offers it exactly the
+        affinity keys the dead replica owned. The replacement arrives
+        ``warming`` (the supervisor admits it after cache warm-up)."""
+        self.links[index] = link
+        link.last_hb = None
+        if hasattr(link, "start_reader"):
+            link.start_reader(self.inbox)
+        self.on_fleet_change()
+
+    def append_link(self, link: ReplicaLink) -> None:
+        """Grow the fleet by one (FleetScaler scale-up): a fresh breaker,
+        a fresh rendezvous name — existing keys keep their owners."""
+        self.links.append(link)
+        self.breakers.append(
+            CircuitBreaker(
+                f"replica_{link.name}", threshold=self._breaker_threshold,
+                cooldown_s=self._breaker_cooldown_s,
+            )
+        )
+        if hasattr(link, "start_reader"):
+            link.start_reader(self.inbox)
+        self.on_fleet_change()
+
+    def reset_breaker(self, index: int) -> None:
+        """A freshly admitted REPLACEMENT process deserves a fresh breaker:
+        the old one's open state belongs to the dead process (an OPEN
+        breaker deliberately ignores stray successes, so re-arming must be
+        explicit, not a side effect of the first answer)."""
+        link = self.links[index]
+        self.breakers[index] = CircuitBreaker(
+            f"replica_{link.name}", threshold=self._breaker_threshold,
+            cooldown_s=self._breaker_cooldown_s,
+        )
+
+    @property
+    def healthy_links(self) -> "list[ReplicaLink]":
+        """Links currently SERVING: live, admitted, not draining — the one
+        definition of fleet size the gauge, the autoscaler, and the warm-
+        source picker all share."""
+        return [
+            l for l in self.links
+            if not l.dead and not l.warming and not l.draining
+        ]
+
+    def on_fleet_change(self) -> None:
+        """Refresh the fleet-size gauges after any membership change."""
+        if self._tel is not None:
+            self._m_replicas.set(sum(1 for l in self.links if not l.dead))
+            self._m_fleet.set(len(self.healthy_links))
+
+    def seed_takeover(
+        self,
+        *,
+        next_order: int,
+        emit_next: int,
+        done: "dict[int, dict]",
+        inflight: "list[tuple[int, _Tracked]]",
+        pending: "list[_Tracked]",
+    ) -> None:
+        """Install adopted state from a warm standby's takeover
+        (``serve/standby.py``): the order clock resumes past every order
+        the primary minted, delivery resumes at the client's floor
+        (``emit_next``), recovered answers land in the funnel, replica-
+        claimed orders are re-owned in the in-flight table exactly once,
+        and unknowns queue for dispatch. Call BEFORE the pump thread
+        starts — this is takeover bootstrap, not a concurrent surface."""
+        with self._intake_lock:
+            self._next_order = max(self._next_order, next_order)
+            self._emit_next = emit_next
+            self._done.update(done)
+            self._pending.extend(pending)
+        for index, rr in inflight:
+            rr.replica = index
+            self._inflight[rr.order] = rr
+            self.links[index].inflight += 1
+        if self.ha:
+            # Re-journal the adopted state: THIS router's journal starts
+            # empty, and route.intake is otherwise only written by
+            # submit()/submit_done() — without these records a SECOND
+            # (chained) standby tailing us would neither re-own nor
+            # re-dispatch the adopted orders and its funnel would wedge
+            # at the delivery floor forever.
+            if emit_next > 0:
+                # Floor mark (n=0): nothing newly delivered, but orders
+                # below emit_next reached the client via a predecessor.
+                self.emit_event(
+                    "route.answered", first=emit_next, upto=emit_next - 1,
+                    n=0,
+                )
+            now = time.perf_counter()
+            for order in sorted(done):
+                self.emit_event("route.intake", order=order,
+                                resp=done[order])
+            for rr in sorted(
+                [rr for _, rr in inflight] + list(pending),
+                key=lambda r: r.order,
+            ):
+                self.emit_event(
+                    "route.intake", order=rr.order, req=rr.req,
+                    traceparent=rr.ctx.to_traceparent(),
+                    deadline_ms=(
+                        None if rr.deadline is None
+                        else round((rr.deadline - now) * 1e3, 3)
+                    ),
+                )
+
+    def _ha_heartbeat(self) -> None:
+        """The primary's liveness beacon for a warm standby
+        (``serve/standby.py``): a periodic ``route.hb`` event on the
+        answer-funnel event log carrying the authority epoch and the
+        replica control ports. The order-keyed inflight table itself is
+        NOT in the beacon — the standby reconstructs it from the
+        ``route.intake``/``route.answered`` records, so the beacon stays
+        O(fleet) on the pump hot path instead of O(inflight) twice a
+        second."""
+        now = time.monotonic()
+        if now - self._last_ha_hb < self.ha_heartbeat_s:
+            return
+        self._last_ha_hb = now
+        self.emit_event(
+            "route.hb",
+            epoch=self.epoch,
+            ports={
+                l.name: l.control_port
+                for l in self.links
+                if l.control_port is not None and not l.retired
+            },
+        )
 
     # -- inbox --------------------------------------------------------------
 
@@ -542,6 +795,14 @@ class Router:
         if kind == "answer":
             self._on_answer(link, msg)
         elif kind == "hb":
+            try:
+                # route.hb fault point: deterministically SWALLOW replica
+                # heartbeats so --fault_spec episodes drill heartbeat-loss
+                # failover storms without real stalls (docs/ROBUSTNESS.md).
+                maybe_fail("route.hb")
+            except InjectedFault:
+                self.stats["dropped_heartbeats"] += 1
+                return
             link.last_hb = time.monotonic()
             link.hb_backlog = int(msg.get("backlog", 0))
             link.hb_free = int(msg.get("free", 0))
@@ -549,10 +810,30 @@ class Router:
         elif kind == "prefilled":
             self._on_prefilled(link, msg)
         elif kind == "exit":
+            # A supervisor-respawned REPLACEMENT at this index must never
+            # be failed over by its predecessor's EOF sentinel: the old
+            # reader thread can outlive the link swap, so the sentinel's
+            # pid must match the CURRENT process behind the link.
+            pid = msg.get("pid")
+            cur = getattr(link, "pid", None)
+            cur = cur() if callable(cur) else None
+            if pid is not None and cur is not None and pid != cur:
+                return
             if not link.dead:
                 self._fail_replica(index, "pipe closed")
         elif kind == "ready":
             link.last_hb = time.monotonic()
+            port = msg.get("control_port")
+            if isinstance(port, int):
+                link.control_port = port
+            if self._sup is not None and link.warming:
+                self._sup.on_ready(link)
+        elif kind == "prefix_state":
+            if self._sup is not None:
+                self._sup.on_prefix_state(link, msg)
+        elif kind == "state_injected":
+            if self._sup is not None:
+                self._sup.on_state_injected(link, msg)
         elif kind == "stats":
             link.final_stats = msg.get("stats")  # bench introspection
 
@@ -579,7 +860,7 @@ class Router:
             resp = error_answer(
                 "internal", f"replica {link.name} returned a malformed answer"
             )
-        self._answer(rr, resp, replica=link.name)
+        self._answer(rr, resp, replica=link.name, slo=msg.get("slo"))
         self.breakers[link.index].record_success()
 
     def _on_prefilled(self, link: ReplicaLink, msg: dict) -> None:
@@ -626,7 +907,7 @@ class Router:
         than the death mark arrives and the breaker cooldown has elapsed;
         its first answered request then closes the breaker, and a fresh
         failure (half-open -> open) restarts the cooldown."""
-        if not link.alive():
+        if link.retired or not link.alive():
             return
         if (
             link.last_hb is None
@@ -639,9 +920,11 @@ class Router:
         link.dead = False
         link.died_at = None
         self.stats["revivals"] += 1
-        if self._tel is not None:
-            self._m_replicas.set(sum(1 for l in self.links if not l.dead))
-            self._tel.emit("route.revive", replica=link.name)
+        self.on_fleet_change()
+        self.emit_event("route.revive", replica=link.name)
+        # A revival also wins the race against a scheduled respawn: the
+        # supervisor's slot returns to "up" on its next poll (link.dead is
+        # False again before the backoff elapses).
 
     def _fail_replica(self, index: int, reason: str) -> None:
         """Zero-loss failover: every in-flight request assigned to the
@@ -652,6 +935,8 @@ class Router:
         written before the death), whichever of answer/redispatch lands
         first wins and the other is dropped/cancelled by the funnel."""
         link = self.links[index]
+        if link.retired:
+            return  # a drained retiree's EOF is not a failure
         link.dead = True
         link.died_at = time.monotonic()
         self.breakers[index].record_failure()
@@ -671,16 +956,16 @@ class Router:
         self.stats["failovers"] += 1
         if self._tel is not None:
             self._m_failover.inc()
-            self._m_replicas.set(
-                sum(1 for l in self.links if not l.dead)
-            )
-            self._tel.emit(
-                "route.failover",
-                replica=link.name,
-                reason=reason,
-                orders=[rr.order for rr in victims],
-                traces=[rr.ctx.trace_id for rr in victims],
-            )
+        self.on_fleet_change()
+        self.emit_event(
+            "route.failover",
+            replica=link.name,
+            reason=reason,
+            orders=[rr.order for rr in victims],
+            traces=[rr.ctx.trace_id for rr in victims],
+        )
+        if self._sup is not None:
+            self._sup.on_death(link)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -688,6 +973,11 @@ class Router:
         out = []
         for link in self.links:
             if link.dead or not link.serves(stage):
+                continue
+            if link.warming or link.draining:
+                # Supervision states: a warming replacement is still
+                # bootstrapping/cache-warming; a draining retiree finishes
+                # its in-flight work but takes nothing new.
                 continue
             if not self.breakers[link.index].allow():
                 continue
@@ -831,10 +1121,29 @@ class Router:
 
     # -- the answer funnel ---------------------------------------------------
 
-    def _answer(self, rr: _Tracked, resp: dict, replica: str = "") -> None:
+    def _answer(
+        self, rr: _Tracked, resp: dict, replica: str = "", slo=None
+    ) -> None:
         with self._intake_lock:
             self._done[rr.order] = resp
         self.stats["answered"] += 1
+        if self._slo_engine is not None:
+            # The router's own SLO engine over the answer funnel: the
+            # replica's per-answer side channel carries ttft/prefix numbers
+            # (serve/replica.py "slo"); router-local answers (queue
+            # deadline, redispatch exhaustion, no-replica) contribute their
+            # availability/deadline weight with no latency sample. This is
+            # the FleetScaler's autoscaling signal.
+            sample = dict(slo) if isinstance(slo, dict) else {}
+            sample["order"] = rr.order
+            sample.setdefault(
+                "total_s", round(time.perf_counter() - rr.t_submit, 6)
+            )
+            if "error" in resp:
+                sample["error"] = resp["error"]
+                if "code" in resp:
+                    sample["code"] = resp["code"]
+            self._slo_engine.record(sample)
         if rr.span_root is not None:
             extra = {}
             if "error" in resp:

@@ -449,6 +449,7 @@ class ContinuousScheduler:
         breaker_cooldown_s: float = 30.0,
         breaker_clock=time.monotonic,
         slos=None,
+        span_tap=None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -568,6 +569,13 @@ class ContinuousScheduler:
         # SLO engine (obs/slo.py): burn-rate evaluation over the answer
         # stream. `slos` is a spec tuple or an --slo_spec string; needs
         # telemetry (gauges + slo.burn events are its whole output).
+        # Span tap: an optional host-side callable handed every answer-
+        # boundary span dict (the same payload `serve.request` events and
+        # the SLO engine see) WITHOUT requiring a telemetry bundle — the
+        # replica worker uses it to ship per-answer ttft/prefix numbers to
+        # the router's own SLO engine over the wire (serve/replica.py).
+        # Host-side only, never traced: jaxpr-inert by construction.
+        self._span_tap = span_tap
         self._slo = None
         if telemetry is not None and slos:
             from transformer_tpu.obs.slo import SLOEngine, parse_slo_spec
@@ -679,6 +687,8 @@ class ContinuousScheduler:
             span.setdefault("trace", root.ctx.trace_id)
         if self._slo is not None:
             self._slo.record(dict(span))
+        if self._span_tap is not None:
+            self._span_tap(dict(span))
         if self._tel is not None:
             self._tel.emit("serve.request", **span)
 
@@ -1718,7 +1728,7 @@ class ContinuousScheduler:
             st, ("span_root",), order=st.order,
             prompt_tokens=st.prompt_len, new_tokens=len(st.emitted),
         )
-        if self._tel is not None:
+        if self._tel is not None or self._span_tap is not None:
             now = time.perf_counter()
             queue_s = st.t_admit - st.t_enqueue
             total_s = now - st.t_enqueue
@@ -1740,17 +1750,18 @@ class ContinuousScheduler:
                 # Recorded on MISSES too (0): summarize's hit rate divides
                 # by prompt_tokens over participating requests only.
                 span["prefix_hit_tokens"] = st.prefix_hit
-            self._m_queue_s.observe(queue_s)
-            self._m_total_s.observe(total_s)
             if st.t_prefill is not None:
-                prefill_s = st.t_prefill - st.t_admit
-                span["prefill_s"] = round(prefill_s, 6)
-                self._m_prefill_s.observe(prefill_s)
+                span["prefill_s"] = round(st.t_prefill - st.t_admit, 6)
             if st.t_first is not None:
-                ttft_s = st.t_first - st.t_enqueue
-                span["ttft_s"] = round(ttft_s, 6)
-                self._m_ttft_s.observe(ttft_s)
-            self._m_retirements.inc()
+                span["ttft_s"] = round(st.t_first - st.t_enqueue, 6)
+            if self._tel is not None:
+                self._m_queue_s.observe(queue_s)
+                self._m_total_s.observe(total_s)
+                if st.t_prefill is not None:
+                    self._m_prefill_s.observe(st.t_prefill - st.t_admit)
+                if st.t_first is not None:
+                    self._m_ttft_s.observe(st.t_first - st.t_enqueue)
+                self._m_retirements.inc()
             self._record_request(span, root=root)
 
     # ---- shutdown ---------------------------------------------------------

@@ -1,0 +1,489 @@
+"""Warm-standby router: tail the primary's log, adopt the fleet on death.
+
+The PR 10 router made replicas expendable and left ITSELF the single
+point of failure. This module is the HA half of the self-healing tier
+(docs/SERVING.md "Router HA"): a :class:`Standby` process tails the
+primary router's JSONL event log — the same answer-funnel log ``obs
+summarize --merge`` reads — and reconstructs, from three event kinds the
+primary emits in ``ha`` mode, everything needed to take over:
+
+- ``route.intake`` — one per accepted order: the request body, its
+  W3C traceparent (so the trace survives the cutover), and its remaining
+  deadline budget; pre-answered orders (parse errors) carry their
+  response inline.
+- ``route.answered`` — delivery marks from ``drain_ready``: orders the
+  CLIENT has already seen. Completion is not delivery — an answer sitting
+  out-of-order in the dead primary's funnel is recovered from the
+  replicas, while delivered orders must never reach the client twice.
+- ``route.hb`` — the primary's liveness beacon: authority epoch and the
+  replica control ports (``serve/replica.py --ha``). The inflight table
+  is NOT in the beacon — it is reconstructed from the intake/answered
+  records above, which an adopting router re-journals for its own
+  successor (``Router.seed_takeover``), so chained takeovers work from
+  each primary's log alone.
+
+**Death detection** is heartbeat silence: when no fresh ``route.hb``
+event lands for ``takeover_after_s`` (local monotonic clock — file
+growth, not event timestamps, so clock skew between the two routers is
+irrelevant), the standby declares the primary dead and adopts.
+
+**The takeover handshake** (per replica, over its localhost control
+socket)::
+
+    -> {"type": "takeover", "epoch": E+1, "inflight": [order, ...]}
+    <- {"type": "adopted", "statuses": {...}, "messages": {...}}
+
+An adopted replica reports every undelivered order as ``done`` (original
+answer replayed from its bounded re-delivery cache — an answer that died
+in the primary's pipe is recovered here), ``inflight`` (it will answer on
+the standby's channel), or ``unknown`` (the standby re-dispatches it).
+``rejected`` means a HIGHER epoch already owns the worker — another
+standby won; this one must stand down (:class:`TakeoverRejected`), which
+is the split-brain guard: authority is totalized by epoch, and the old
+primary's still-arriving requests are dropped and counted replica-side.
+The ``route.takeover`` fault point fires inside each per-replica
+handshake so ``--fault_spec`` episodes drill partial adoptions
+deterministically (docs/ROBUSTNESS.md).
+
+The result of :meth:`Standby.adopt` is a fully seeded
+:class:`~transformer_tpu.serve.router.Router` (epoch E+1, ``ha`` mode —
+it immediately starts emitting its own beacon for the NEXT standby):
+delivered orders excluded, recovered answers pre-seeded, replica-claimed
+orders re-owned exactly once in the in-flight table, unknowns queued for
+dispatch. Clients see at-most-once answers across the cutover: the
+delivered-prefix floor, the replicas' epoch guard, and the adopting
+funnel's duplicate drop together make the exactly-once drill in
+tests/test_router.py hold under every interleaving the schedule checker
+explores.
+
+Threading: the standby is single-threaded until adoption (tail + poll);
+after :meth:`adopt` the usual router contract applies (reader threads
+feed the inbox, one pump thread owns the tables).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+from transformer_tpu.obs.trace import SpanContext
+from transformer_tpu.serve.resilience import maybe_fail
+from transformer_tpu.serve.router import ReplicaLink, Router, _Tracked
+
+
+class TakeoverRejected(RuntimeError):
+    """A replica answered the handshake with a HIGHER authority epoch:
+    another standby already adopted the fleet. This standby must stand
+    down — proceeding would be exactly the split brain the epoch
+    totalizes away."""
+
+
+class TakeoverLink(ReplicaLink):
+    """A replica link over the worker's ``--ha`` control socket — the
+    adopting router's transport. Same three-method surface as every other
+    link; ``alive()`` is socket health (the worker process outlives its
+    primary by design, so pipe liveness is the only observable)."""
+
+    def __init__(self, index: int, name: str, sock, rfile, wfile,
+                 role: str = "both"):
+        super().__init__(index, name, role=role)
+        self._sock = sock
+        self._rf = rfile
+        self._wf = wfile
+        self._broken = False
+
+    def send(self, msg: dict) -> None:
+        if self._broken:
+            raise BrokenPipeError(f"replica {self.name} control socket gone")
+        try:
+            self._wf.write(json.dumps(msg) + "\n")
+            self._wf.flush()
+        except (OSError, ValueError) as e:
+            self._broken = True
+            raise BrokenPipeError(str(e)) from e
+
+    def alive(self) -> bool:
+        return not self._broken
+
+    def start_reader(self, inbox) -> None:
+        import threading
+
+        def _read():
+            try:
+                for line in self._rf:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        msg = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(msg, dict):
+                        inbox.put((self.index, msg))
+            except (OSError, ValueError):
+                pass
+            self._broken = True
+            inbox.put((self.index, {"type": "exit"}))
+
+        threading.Thread(
+            target=_read, name=f"standby-read-{self.name}", daemon=True
+        ).start()
+
+    def kill(self) -> None:
+        self._broken = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self, timeout: float = 10.0) -> None:
+        try:
+            self.send({"type": "shutdown"})
+        except (OSError, ValueError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Standby:
+    """Tail the primary's event log; adopt its fleet when it goes silent.
+
+    ``router_kwargs`` is forwarded to the adopted :class:`Router`
+    (telemetry, supervisor, scaler, slos, dispatch knobs) — the standby
+    becomes a first-class primary, supervision tier included. ``clock``
+    and the log reader are injectable so tests drive the death detector
+    deterministically.
+    """
+
+    def __init__(
+        self,
+        log_path: str,
+        *,
+        takeover_after_s: float = 2.0,
+        connect_timeout_s: float = 5.0,
+        encode=None,
+        bos_id: int = 1,
+        telemetry=None,
+        clock=time.monotonic,
+        router_kwargs: "dict | None" = None,
+    ):
+        self.log_path = log_path
+        self.takeover_after_s = takeover_after_s
+        self.connect_timeout_s = connect_timeout_s
+        self.encode = encode
+        self.bos_id = bos_id
+        self._tel = telemetry
+        self._clock = clock
+        self._router_kwargs = dict(router_kwargs or {})
+        self._offset = 0
+        self._partial = ""
+        # Reconstructed primary state (all from the log tail).
+        self.epoch = 1
+        self.ports: "dict[str, int]" = {}
+        self.intake: "dict[int, dict]" = {}
+        self.max_order = -1          # highest order ever seen (intake is
+        #                              pruned at delivery; the order clock
+        #                              must still resume past everything)
+        self.delivered_upto = 0      # _emit_next floor: client saw [0, upto)
+        self._last_hb_local: "float | None" = None
+        self._saw_hb = False
+        self.stats = {
+            "hb_seen": 0, "intake_seen": 0, "recovered_answers": 0,
+            "reowned_inflight": 0, "redispatched": 0, "skipped_replicas": 0,
+        }
+        self._m_state = None
+        if telemetry is not None:
+            self._m_state = telemetry.registry.gauge(
+                "route_standby_state",
+                "0 = tailing the primary, 1 = adopting, 2 = primary",
+            )
+            self._m_state.set(0)
+
+    # -- the tail (standby thread) -------------------------------------------
+
+    def _read_new_events(self) -> "list[dict]":
+        out: list[dict] = []
+        try:
+            with open(self.log_path) as f:
+                f.seek(self._offset)
+                chunk = f.read()
+                self._offset = f.tell()
+        except OSError:
+            return out
+        if not chunk:
+            return out
+        data = self._partial + chunk
+        lines = data.split("\n")
+        # The last element is either "" (chunk ended on a newline) or a
+        # torn line mid-write — keep it for the next read either way.
+        self._partial = lines.pop()
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict):
+                out.append(ev)
+        return out
+
+    def _ingest(self, ev: dict) -> None:
+        kind = ev.get("kind")
+        if kind == "route.hb":
+            self.stats["hb_seen"] += 1
+            self._saw_hb = True
+            self._last_hb_local = self._clock()
+            self.epoch = max(self.epoch, int(ev.get("epoch", 1)))
+            ports = ev.get("ports")
+            if isinstance(ports, dict):
+                self.ports = {
+                    str(k): int(v)
+                    for k, v in ports.items()
+                    if isinstance(v, int)
+                }
+        elif kind == "route.intake":
+            order = ev.get("order")
+            if isinstance(order, int):
+                self.stats["intake_seen"] += 1
+                self.max_order = max(self.max_order, order)
+                if order >= self.delivered_upto:
+                    self.intake[order] = ev
+        elif kind == "route.answered":
+            upto = ev.get("upto")
+            if isinstance(upto, int):
+                self.delivered_upto = max(self.delivered_upto, upto + 1)
+                # Delivered orders can never be re-owned or re-answered:
+                # drop their intake records so a standby tailing a
+                # long-running primary stays bounded by the IN-FLIGHT
+                # window, not by every request ever accepted.
+                for order in [
+                    o for o in self.intake if o < self.delivered_upto
+                ]:
+                    del self.intake[order]
+
+    def poll(self) -> float:
+        """Ingest new log lines; returns seconds of heartbeat silence
+        (0.0 until the first poll establishes a baseline)."""
+        for ev in self._read_new_events():
+            self._ingest(ev)
+        now = self._clock()
+        if self._last_hb_local is None:
+            # Start the silence clock at first observation: a standby
+            # pointed at a log whose primary is ALREADY dead must still
+            # time out (there will never be a fresh heartbeat to see).
+            self._last_hb_local = now
+            return 0.0
+        return now - self._last_hb_local
+
+    @property
+    def primary_dead(self) -> bool:
+        return (
+            self._last_hb_local is not None
+            and self._clock() - self._last_hb_local > self.takeover_after_s
+        )
+
+    def run_until_takeover(
+        self, poll_s: float = 0.1, timeout: "float | None" = None,
+        sleep=time.sleep,
+    ) -> Router:
+        """The standby main loop: tail until the primary goes silent,
+        then :meth:`adopt`. ``timeout`` bounds the wait (None = forever)."""
+        t0 = self._clock()
+        self.poll()
+        while not self.primary_dead:
+            if timeout is not None and self._clock() - t0 > timeout:
+                raise TimeoutError(
+                    f"primary still alive after {timeout}s of standby"
+                )
+            sleep(poll_s)
+            self.poll()
+        return self.adopt()
+
+    # -- the takeover (once) -------------------------------------------------
+
+    def _handshake(
+        self, index: int, name: str, port: int, ask: "list[int]"
+    ) -> "tuple[TakeoverLink, dict, dict]":
+        maybe_fail("route.takeover")
+        sock = socket.create_connection(
+            ("127.0.0.1", port), timeout=self.connect_timeout_s
+        )
+        wf = sock.makefile("w", encoding="utf-8", buffering=1)
+        rf = sock.makefile("r", encoding="utf-8")
+        wf.write(json.dumps({
+            "type": "takeover", "epoch": self.epoch + 1, "inflight": ask,
+        }) + "\n")
+        wf.flush()
+        line = rf.readline()
+        if not line:
+            raise OSError(f"replica {name} closed the control socket")
+        reply = json.loads(line)
+        if reply.get("type") == "rejected":
+            sock.close()
+            raise TakeoverRejected(
+                f"replica {name} is owned by epoch {reply.get('epoch')} "
+                f">= {self.epoch + 1}: another standby adopted the fleet"
+            )
+        if reply.get("type") != "adopted":
+            raise OSError(f"replica {name} answered {reply.get('type')!r}")
+        sock.settimeout(None)
+        link = TakeoverLink(
+            index, name, sock, rf, wf, role=str(reply.get("role", "both")),
+        )
+        # The adopting router's own HA beacon must advertise the control
+        # ports (the workers only announce them once, at bootstrap) — a
+        # SECOND standby adopts from the new primary's journal the same
+        # way the first did from the original's.
+        link.control_port = port
+        statuses = reply.get("statuses") or {}
+        messages = reply.get("messages") or {}
+        return link, statuses, messages
+
+    def _rebuild_tracked(self, order: int, now: float) -> _Tracked:
+        ev = self.intake.get(order) or {}
+        req = ev.get("req")
+        if not isinstance(req, dict):
+            req = {"prompt": ""}
+        ctx = SpanContext.from_traceparent(ev.get("traceparent"))
+        if ctx is None:
+            ctx = SpanContext.new()
+        deadline = None
+        d = ev.get("deadline_ms")
+        ts = ev.get("ts")
+        if isinstance(d, (int, float)) and isinstance(ts, (int, float)):
+            # Remaining budget measured against wall time elapsed since
+            # the intake record was written: the deadline contract rides
+            # the cutover (an order whose budget died with the primary
+            # answers a structured deadline error, not a zombie success).
+            remaining = (ts + d / 1e3) - time.time()
+            deadline = now + remaining
+        return _Tracked(
+            order=order, req=req, ctx=ctx, t_submit=now, deadline=deadline,
+            affinity=None,
+        )
+
+    def adopt(self) -> Router:
+        """Perform the takeover: handshake every known replica, re-own the
+        inflight table exactly once, and return the seeded router (epoch
+        bumped, ``ha`` mode on — the next standby tails US)."""
+        if self._m_state is not None:
+            self._m_state.set(1)
+        now = time.perf_counter()
+        undelivered = sorted(
+            o for o in self.intake if o >= self.delivered_upto
+        )
+        done: "dict[int, dict]" = {}
+        ask: "list[int]" = []
+        for order in undelivered:
+            resp = self.intake[order].get("resp")
+            if isinstance(resp, dict):
+                done[order] = resp  # pre-answered at the primary (parse
+                #                     errors): the log alone recovers it
+            else:
+                ask.append(order)
+        links: "list[TakeoverLink]" = []
+        statuses: "dict[int, tuple[str, int]]" = {}
+        messages: "dict[int, dict]" = {}
+        failed: "list[str]" = []
+        for name in sorted(self.ports):
+            index = len(links)
+            try:
+                link, sts, msgs = self._handshake(
+                    index, name, self.ports[name], ask
+                )
+            except TakeoverRejected:
+                raise
+            except (OSError, ValueError):
+                # route.takeover fault / dead worker / torn reply: a
+                # partial adoption — the missing replica's claimed work
+                # surfaces as "unknown" elsewhere and re-dispatches.
+                self.stats["skipped_replicas"] += 1
+                failed.append(name)
+                continue
+            links.append(link)
+            for rid_s, status in sts.items():
+                try:
+                    rid = int(rid_s)
+                except (TypeError, ValueError):
+                    continue
+                # Strongest claim wins: "done" (the answer is already
+                # computed — replaying beats re-owning) over "inflight"
+                # (the owner keeps it) over "unknown" (every replica
+                # reports every asked rid, so an early peer's "unknown"
+                # must never block the real owner's later claim).
+                rank = {"done": 2, "inflight": 1}.get(status, 0)
+                cur = statuses.get(rid)
+                if cur is None or rank > {"done": 2, "inflight": 1}.get(
+                    cur[0], 0
+                ):
+                    statuses[rid] = (status, index)
+                if status == "done":
+                    msg = msgs.get(rid_s)
+                    if isinstance(msg, dict):
+                        messages[rid] = msg
+        if not links:
+            if self._m_state is not None:
+                self._m_state.set(0)
+            raise RuntimeError(
+                "takeover adopted zero replicas "
+                f"(ports={self.ports}, failed={failed})"
+            )
+        inflight: "list[tuple[int, _Tracked]]" = []
+        pending: "list[_Tracked]" = []
+        for order in ask:
+            status, index = statuses.get(order, ("unknown", -1))
+            msg = messages.get(order)
+            if status == "done" and isinstance(msg, dict) and isinstance(
+                msg.get("resp"), dict
+            ):
+                # Recovered: the answer died in the primary's pipe but
+                # lives in the replica's re-delivery cache.
+                done[order] = msg["resp"]
+                self.stats["recovered_answers"] += 1
+            elif status == "inflight":
+                rr = self._rebuild_tracked(order, now)
+                inflight.append((index, rr))
+                self.stats["reowned_inflight"] += 1
+            else:
+                # unknown everywhere (or a non-answer replay, e.g. a
+                # disaggregation handoff that died with the primary):
+                # re-dispatch from the intake record.
+                pending.append(self._rebuild_tracked(order, now))
+                self.stats["redispatched"] += 1
+        router = Router(
+            links,
+            encode=self.encode,
+            bos_id=self.bos_id,
+            telemetry=self._tel,
+            ha=True,
+            epoch=self.epoch + 1,
+            **self._router_kwargs,
+        )
+        router.seed_takeover(
+            next_order=max(self.max_order + 1, self.delivered_upto),
+            emit_next=self.delivered_upto,
+            done=done,
+            inflight=inflight,
+            pending=pending,
+        )
+        for link in links:
+            link.start_reader(router.inbox)
+        if self._m_state is not None:
+            self._m_state.set(2)
+        if self._tel is not None:
+            self._tel.emit(
+                "route.takeover",
+                epoch=self.epoch + 1,
+                adopted=[l.name for l in links],
+                failed=failed,
+                recovered_answers=self.stats["recovered_answers"],
+                reowned_inflight=self.stats["reowned_inflight"],
+                redispatched=self.stats["redispatched"],
+                delivered_upto=self.delivered_upto,
+            )
+        return router

@@ -1,0 +1,512 @@
+"""Fleet supervision: replica respawn, warm-up, and SLO-driven scaling.
+
+PR 10 gave the serving tier horizontal scale with zero-loss failover — and
+left the fleet able only to DEGRADE: a SIGKILLed replica stayed dead, the
+fleet size was fixed at launch, and the router was the single point of
+failure. This module closes the control loop the ROADMAP's "Pod-scale
+elasticity" item names, on top of two things earlier PRs made
+deterministic: the PR 7 fault plane (so every supervision decision is
+drillable in CI — ``route.spawn`` can crash-loop a bootstrap on demand)
+and the PR 9 SLO burn-rate engine (so fleet size is DRIVEN by the error
+budget, not by a load average someone eyeballed).
+
+Two objects, both owned by the router thread (docs/SERVING.md
+"Self-healing fleet"):
+
+- :class:`Supervisor` — replica lifecycle. When the router fails a
+  replica over (pipe EOF, exit, missed heartbeats past the breaker
+  cooldown), the supervisor re-bootstraps a replacement from the SAME
+  deterministic spawn recipe (``--model_spec`` worker argv), under the
+  replica's OLD name — rendezvous hashing therefore hands it exactly the
+  affinity keys it used to own. Before the replacement takes traffic, its
+  ``PrefixCache`` is warmed from a survivor over the existing
+  ``export_blocks``/``inject_blocks`` wire format (``export_state`` /
+  ``inject_state`` protocol messages): the respawned replica's first
+  affine request is a prefix HIT, not a cold full prefill. Respawns are
+  budgeted (``max_restarts`` within ``restart_window_s``, exponential
+  backoff between attempts): a crash-looping bootstrap exhausts its
+  budget and leaves the per-replica breaker OPEN — the fleet serves at
+  N-1 instead of burning CPU on a spin, and the give-up is an explicit
+  ``route.spawn`` event with ``gave_up=true``.
+- :class:`FleetScaler` — fleet sizing. Consumes the burn-rate gauges of
+  the router's own :class:`~transformer_tpu.obs.slo.SLOEngine` (fed by
+  the answer funnel with the per-answer ``slo`` side channel the replicas
+  ship): the watched signal (default ``ttft_p95``) burning > 1 for
+  ``sustain_s`` sustained seconds spawns a replica (up to
+  ``max_replicas``); a fleet idle for ``idle_s`` (empty backlog, zero
+  in-flight, burn at 0) drains the youngest replica through the existing
+  dispatch policy (mark draining -> stop offering traffic -> shutdown
+  when empty) and retires it. Every decision is a ``route.scale`` event
+  carrying the evidence window that justified it.
+
+Threading contract (linted by TPA101-105; explored by
+``analysis/schedules.py supervisor_respawn``): every method here runs on
+the ROUTER thread (``Router.pump`` calls :meth:`Supervisor.poll` /
+:meth:`FleetScaler.poll`; message handlers are dispatched from the
+router's inbox drain). The spawn callable may block briefly
+(``subprocess.Popen``); nothing here takes locks or touches jax — like
+the router, the supervision tier is model-free host code.
+"""
+
+from __future__ import annotations
+
+import time
+
+from transformer_tpu.serve.resilience import maybe_fail
+
+
+class _SlotState:
+    """Per-replica-index respawn bookkeeping (router-thread-owned)."""
+
+    __slots__ = (
+        "index", "name", "role", "phase", "next_try", "attempts",
+        "restarts", "died_at", "warm_deadline", "warm_source",
+    )
+
+    def __init__(self, index: int, name: str, role: str):
+        self.index = index
+        self.name = name
+        self.role = role
+        self.phase = "up"  # up | waiting | booting | warming
+        self.next_try = 0.0
+        self.attempts = 0          # consecutive failed respawns
+        self.restarts: list[float] = []  # attempt timestamps (budget window)
+        self.died_at: float | None = None
+        self.warm_deadline = 0.0
+        self.warm_source: int | None = None
+
+
+class Supervisor:
+    """Respawn dead replicas, warm them from survivors, admit them back.
+
+    ``spawn(index, name, role) -> ReplicaLink`` is the re-bootstrap
+    recipe — for the subprocess tier,
+    ``ReplicaProcess.spawn``-with-the-same-worker-argv (``cli/router.py``
+    builds it); tests substitute fakes. A spawn that raises (or a
+    replacement that dies before admission) counts against the restart
+    budget; :data:`~transformer_tpu.serve.resilience.FAULT_POINTS`'s
+    ``route.spawn`` fires inside every attempt so crash-loop storms drill
+    deterministically.
+    """
+
+    def __init__(
+        self,
+        spawn,
+        *,
+        max_restarts: int = 3,
+        restart_window_s: float = 120.0,
+        backoff_ms: float = 200.0,
+        backoff_max_ms: float = 10_000.0,
+        boot_timeout_s: float = 60.0,
+        warm_prefixes: int = 8,
+        warm_timeout_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self._spawn = spawn
+        self.max_restarts = max(1, max_restarts)
+        self.restart_window_s = restart_window_s
+        self.backoff_ms = backoff_ms
+        self.backoff_max_ms = backoff_max_ms
+        self.boot_timeout_s = boot_timeout_s
+        self.warm_prefixes = warm_prefixes
+        self.warm_timeout_s = warm_timeout_s
+        self._clock = clock
+        self._router = None
+        self._slots: dict[int, _SlotState] = {}
+        self.stats = {
+            "respawns": 0, "spawn_attempts": 0, "spawn_failures": 0,
+            "gave_up": 0, "warmed_tokens": 0, "scale_ups": 0, "retired": 0,
+        }
+        self.heal_times: list[float] = []  # death -> admitted, seconds
+
+    # -- wiring (router thread) ---------------------------------------------
+
+    def attach(self, router) -> None:
+        self._router = router
+        for link in router.links:
+            self._slots[link.index] = _SlotState(
+                link.index, link.name, link.role
+            )
+
+    def _slot(self, index: int) -> _SlotState:
+        if index not in self._slots:
+            link = self._router.links[index]
+            self._slots[index] = _SlotState(index, link.name, link.role)
+        return self._slots[index]
+
+    # -- lifecycle events (router thread) -----------------------------------
+
+    def on_death(self, link) -> None:
+        """A replica the router just failed over. Schedule a respawn —
+        after the breaker cooldown when the PROCESS still runs (the
+        half-open revival path gets first claim on a stalled-but-alive
+        worker), after the exponential backoff otherwise."""
+        if getattr(link, "retired", False):
+            return
+        slot = self._slot(link.index)
+        if slot.phase == "gave_up":
+            return  # the budget is spent; only an explicit re-arm respawns
+        now = self._clock()
+        if slot.phase == "up":
+            slot.died_at = now
+        was_booting = slot.phase in ("booting", "warming")
+        slot.phase = "waiting"
+        if was_booting:
+            # The replacement itself died before admission: a crash-loop
+            # signature — count it and back off harder.
+            self._count_failure(slot, now)
+            if slot.phase == "gave_up":
+                return
+        delay = self._backoff_s(slot.attempts)
+        if link.alive():
+            delay = max(
+                delay, self._router.breakers[link.index].cooldown_s
+            )
+        slot.next_try = now + delay
+
+    def _backoff_s(self, attempts: int) -> float:
+        return min(
+            self.backoff_ms * (2 ** attempts), self.backoff_max_ms
+        ) / 1e3
+
+    def _count_failure(self, slot: _SlotState, now: float) -> None:
+        slot.attempts += 1
+        slot.restarts.append(now)
+        self.stats["spawn_failures"] += 1
+        self._router.breakers[slot.index].record_failure()
+        window = [
+            t for t in slot.restarts if now - t <= self.restart_window_s
+        ]
+        slot.restarts = window
+        if len(window) >= self.max_restarts:
+            # Crash loop: stop burning CPU. The breaker stays open, the
+            # fleet serves at N-1, and the give-up is an explicit event —
+            # an operator (or a later manual revive) re-arms the slot.
+            slot.phase = "gave_up"
+            self.stats["gave_up"] += 1
+            self._router.emit_event(
+                "route.spawn", replica=slot.name, gave_up=True,
+                attempts=len(window),
+                window_s=self.restart_window_s,
+            )
+
+    # -- the poll loop (router thread, from Router.pump) --------------------
+
+    def poll(self) -> bool:
+        """Advance every slot's respawn/warm state machine one turn.
+        Returns whether anything progressed (the pump idle signal)."""
+        if self._router is None:
+            return False
+        progressed = False
+        now = self._clock()
+        for slot in list(self._slots.values()):
+            link = self._router.links[slot.index]
+            if slot.phase == "waiting" and now >= slot.next_try:
+                if not link.dead:
+                    # The half-open revival path won while we backed off.
+                    slot.phase = "up"
+                    slot.attempts = 0
+                    continue
+                progressed |= self._try_spawn(slot, now)
+            elif slot.phase == "booting" and now >= slot.warm_deadline:
+                # No ready within the boot timeout: treat as a failed
+                # attempt (kill the straggler so the next spawn owns the
+                # name cleanly).
+                link.kill()
+                self._count_failure(slot, now)
+                if slot.phase != "gave_up":
+                    slot.phase = "waiting"
+                    slot.next_try = now + self._backoff_s(slot.attempts)
+                progressed = True
+            elif slot.phase == "warming" and now >= slot.warm_deadline:
+                # Warm-up is best-effort: a slow/dead survivor must not
+                # keep a healthy replacement out of the fleet.
+                self._admit(link, warmed_tokens=0, timed_out=True)
+                progressed = True
+        return progressed
+
+    def _try_spawn(self, slot: _SlotState, now: float) -> bool:
+        link = self._router.links[slot.index]
+        if link.alive():
+            # Stalled-but-alive past its cooldown grace and never revived:
+            # reclaim the slot before re-bootstrapping.
+            link.kill()
+        self.stats["spawn_attempts"] += 1
+        try:
+            maybe_fail("route.spawn")
+            new_link = self._spawn(slot.index, slot.name, slot.role)
+        except Exception:  # noqa: BLE001 — every spawn failure (injected or real: fork limits, a corrupt model spec) is one budgeted attempt, never a crash of the router  # tpa: disable=TPA006
+            self._count_failure(slot, now)
+            if slot.phase != "gave_up":
+                slot.next_try = now + self._backoff_s(slot.attempts)
+            return True
+        new_link.warming = True
+        self._router.replace_link(slot.index, new_link)
+        slot.phase = "booting"
+        slot.warm_deadline = now + self.boot_timeout_s
+        return True
+
+    def on_ready(self, link) -> None:
+        """The replacement bootstrapped. Warm its PrefixCache from the
+        least-loaded healthy survivor before admitting traffic; with no
+        survivor (or no caches), admit cold immediately."""
+        slot = self._slot(link.index)
+        if slot.phase != "booting":
+            return
+        survivor = self._pick_survivor(link.index)
+        if survivor is None:
+            self._admit(link, warmed_tokens=0)
+            return
+        try:
+            survivor.send({
+                "type": "export_state", "limit": self.warm_prefixes,
+            })
+        except (OSError, ValueError):
+            self._admit(link, warmed_tokens=0)
+            return
+        slot.phase = "warming"
+        slot.warm_source = survivor.index
+        slot.warm_deadline = self._clock() + self.warm_timeout_s
+
+    def _pick_survivor(self, exclude: int):
+        best = None
+        for link in self._router.healthy_links:
+            if link.index == exclude:
+                continue
+            if best is None or link.inflight < best.inflight:
+                best = link
+        return best
+
+    def on_prefix_state(self, from_link, msg: dict) -> None:
+        """A survivor answered ``export_state``: forward the payload to
+        whichever replacement is warming against it."""
+        for slot in self._slots.values():
+            if slot.phase != "warming" or slot.warm_source != from_link.index:
+                continue
+            newbie = self._router.links[slot.index]
+            entries = msg.get("entries") or []
+            if not entries:
+                self._admit(newbie, warmed_tokens=0)
+                return
+            try:
+                newbie.send({"type": "inject_state", "entries": entries})
+            except (OSError, ValueError):
+                # The replacement died mid-warm; liveness sweep handles it.
+                pass
+            return
+
+    def on_state_injected(self, link, msg: dict) -> None:
+        slot = self._slot(link.index)
+        if slot.phase != "warming":
+            return
+        self._admit(link, warmed_tokens=int(msg.get("tokens", 0)))
+
+    def _admit(self, link, warmed_tokens: int, timed_out: bool = False) -> None:
+        """The replacement joins the fleet: rendezvous hashing under its
+        old name resumes handing it its affinity keys."""
+        slot = self._slot(link.index)
+        scale_up = slot.died_at is None  # a FleetScaler spawn, not a heal
+        heal_s = None
+        if slot.died_at is not None:
+            heal_s = self._clock() - slot.died_at
+            self.heal_times.append(heal_s)
+        slot.phase = "up"
+        slot.attempts = 0
+        slot.died_at = None
+        link.warming = False
+        link.dead = False
+        link.died_at = None
+        # The dead process's breaker state dies with it: the replacement
+        # starts CLOSED (an OPEN breaker ignores stray successes by
+        # design, so re-arming is this explicit act, never a side effect).
+        self._router.reset_breaker(link.index)
+        self.stats["respawns"] += 0 if scale_up else 1
+        self.stats["warmed_tokens"] += warmed_tokens
+        self._router.on_fleet_change()
+        self._router.emit_event(
+            "route.spawn", replica=slot.name,
+            scale_up=scale_up,
+            heal_s=None if heal_s is None else round(heal_s, 6),
+            warmed_tokens=warmed_tokens,
+            warm_timed_out=timed_out or None,
+        )
+
+    # -- fleet sizing (FleetScaler / operator surface) ----------------------
+
+    def spawn_new(self, role: str = "both") -> bool:
+        """Grow the fleet by one replica (scale-up). The newcomer warms
+        like a respawn and joins rendezvous hashing under a fresh name."""
+        index = len(self._router.links)
+        name = f"replica{index}"
+        self.stats["spawn_attempts"] += 1
+        try:
+            maybe_fail("route.spawn")
+            link = self._spawn(index, name, role)
+        except Exception:  # noqa: BLE001 — a failed scale-up is a skipped decision, not a router crash  # tpa: disable=TPA006
+            self.stats["spawn_failures"] += 1
+            return False
+        link.warming = True
+        self._router.append_link(link)
+        slot = _SlotState(index, name, role)
+        slot.phase = "booting"
+        slot.warm_deadline = self._clock() + self.boot_timeout_s
+        self._slots[index] = slot
+        self.stats["scale_ups"] += 1
+        return True
+
+    def retire(self, link) -> None:
+        """Begin draining ``link``: the dispatcher stops offering it
+        traffic; :meth:`poll`'s sweep ships the shutdown once its
+        in-flight work answers (Router.pump calls :meth:`reap_draining`)."""
+        link.draining = True
+
+    def reap_draining(self) -> bool:
+        progressed = False
+        for link in self._router.links:
+            if not getattr(link, "draining", False) or link.dead:
+                continue
+            if link.inflight > 0:
+                continue
+            try:
+                link.send({"type": "shutdown"})
+            except (OSError, ValueError):
+                pass
+            link.draining = False
+            link.dead = True
+            link.retired = True
+            slot = self._slot(link.index)
+            slot.phase = "retired"
+            self.stats["retired"] += 1
+            self._router.on_fleet_change()
+            self._router.emit_event("route.retire", replica=link.name)
+            progressed = True
+        return progressed
+
+
+class FleetScaler:
+    """SLO-burn-driven fleet sizing (the autoscaling policy object).
+
+    Reads the router's live :class:`~transformer_tpu.obs.slo.SLOEngine`
+    burn rates — ``signal`` (default ``ttft_p95``) burning > 1 for
+    ``sustain_s`` sustained seconds spawns a replica through the
+    supervisor (bounded by ``max_replicas``); a fleet idle for ``idle_s``
+    (no backlog, no in-flight, burn at 0) retires one (bounded below by
+    ``min_replicas``), youngest first so the original rendezvous roster
+    is disturbed least. ``cooldown_s`` separates consecutive decisions —
+    a burn spike must not double-spawn before its first remedy lands.
+    Every decision emits ``route.scale`` with the evidence window (the
+    per-window burn rates that justified it) attached.
+    """
+
+    def __init__(
+        self,
+        *,
+        signal: str = "ttft_p95",
+        sustain_s: float = 5.0,
+        idle_s: float = 30.0,
+        max_replicas: int = 4,
+        min_replicas: int = 1,
+        cooldown_s: float = 15.0,
+        clock=time.monotonic,
+    ):
+        self.signal = signal
+        self.sustain_s = sustain_s
+        self.idle_s = idle_s
+        self.max_replicas = max(1, max_replicas)
+        self.min_replicas = max(1, min_replicas)
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._router = None
+        self._sup = None
+        self._burn_since: float | None = None
+        self._idle_since: float | None = None
+        self._last_action = 0.0
+        self._last_eval: dict = {}
+        self.stats = {"scale_up": 0, "scale_down": 0, "skipped_at_max": 0}
+
+    def bind(self, router, supervisor: Supervisor) -> None:
+        self._router = router
+        self._sup = supervisor
+
+    def _healthy_count(self) -> int:
+        return len(self._router.healthy_links)
+
+    def poll(self, slo_result: "dict | None") -> bool:
+        """One scaling turn (router thread, after an SLO evaluation —
+        ``slo_result`` is ``SLOEngine.evaluate()``'s payload, or None when
+        no evaluation ran this pump)."""
+        if self._router is None or self._sup is None:
+            return False
+        now = self._clock()
+        if slo_result is not None:
+            self._last_eval = slo_result
+        sig = self._last_eval.get(self.signal)
+        burn = sig["burn_rate"] if sig else 0.0
+        healthy = self._healthy_count()
+        # ---- scale up: sustained burn > 1 on the watched signal ----------
+        if burn > 1.0:
+            self._idle_since = None
+            if self._burn_since is None:
+                self._burn_since = now
+            sustained = now - self._burn_since
+            if (
+                sustained >= self.sustain_s
+                and now - self._last_action >= self.cooldown_s
+            ):
+                if healthy >= self.max_replicas:
+                    self.stats["skipped_at_max"] += 1
+                    self._last_action = now  # re-arm, don't spam events
+                    return False
+                if self._sup.spawn_new():
+                    self._last_action = now
+                    self.stats["scale_up"] += 1
+                    self._router.emit_event(
+                        "route.scale", direction="up", signal=self.signal,
+                        burn_rate=burn, sustained_s=round(sustained, 3),
+                        fleet_size=healthy + 1,
+                        evidence=sig.get("windows") if sig else None,
+                    )
+                    return True
+                # A FAILED spawn re-arms the cooldown too: burn is highest
+                # exactly when fork/bootstrap is most likely to fail, and
+                # falling through would retry at pump frequency — one
+                # budgeted attempt per cooldown, like the respawn path.
+                self._last_action = now
+            return False
+        self._burn_since = None
+        # ---- scale down: sustained idleness ------------------------------
+        idle = (
+            self._router.backlog == 0
+            and all(l.inflight == 0 for l in self._router.links)
+            and burn == 0.0
+        )
+        if not idle:
+            self._idle_since = None
+            return False
+        if self._idle_since is None:
+            self._idle_since = now
+            return False
+        sustained = now - self._idle_since
+        if (
+            sustained >= self.idle_s
+            and now - self._last_action >= self.cooldown_s
+            and healthy > self.min_replicas
+        ):
+            victim = None
+            for link in self._router.healthy_links:  # youngest healthy
+                if victim is None or link.index > victim.index:
+                    victim = link
+            if victim is None:
+                return False
+            self._sup.retire(victim)
+            self._last_action = now
+            self._idle_since = None
+            self.stats["scale_down"] += 1
+            self._router.emit_event(
+                "route.scale", direction="down", signal=self.signal,
+                burn_rate=burn, sustained_idle_s=round(sustained, 3),
+                replica=victim.name, fleet_size=healthy - 1,
+                evidence=sig.get("windows") if sig else None,
+            )
+            return True
+        return False

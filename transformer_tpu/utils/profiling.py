@@ -56,6 +56,17 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Small compiles are cheaper to redo than to hash + load.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # jax binds the cache directory ONCE, lazily, at the first jit after
+    # import — a dir configured after any compile has happened is silently
+    # ignored for the life of the process. Reset so this call's dir takes
+    # effect no matter when it runs (the CLI enables the cache after flag
+    # parsing, by which point absl/jax warmup may already have compiled).
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass  # older jax: the lazy init below is the only binding anyway
     return cache_dir
 
 
