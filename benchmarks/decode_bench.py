@@ -36,6 +36,35 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+class _IdTok:
+    """Tokens ARE ids ("3 17 5" -> [3, 17, 5]): the scheduler needs only
+    encode/decode/bos/eos, and a real subword vocab would just blur the
+    token accounting the scheduler sweeps report."""
+
+    bos_id, eos_id = 1, 2
+
+    def encode(self, text):
+        return [int(t) for t in text.split()]
+
+    def decode(self, toks):
+        return " ".join(str(t) for t in toks)
+
+
+def _system_prompt_requests(rng, vocab: int, prompt_len: int, n: int):
+    """The repeated-system-prompt workload both scheduler sweeps serve:
+    every request carries one shared system prompt plus a 4-id tail."""
+    system = rng.integers(3, vocab - 2, prompt_len)
+    return [
+        {
+            "prompt": " ".join(
+                map(str, [*system, *rng.integers(3, vocab - 2, 4)])
+            ),
+            "max_new": 4,
+        }
+        for _ in range(n)
+    ]
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--batch", type=int, default=8)
@@ -58,6 +87,18 @@ def main() -> None:
                         "shared system prompt + small unique tail)")
     p.add_argument("--prefix_block", type=int, default=16,
                    help="prefix-cache block granularity for --prefix_reuse")
+    p.add_argument("--kv_layout", type=str, default="",
+                   help="comma-separated KV layout sweep ('dense,paged'): "
+                        "run the repeated-system-prompt workload through "
+                        "the continuous scheduler per layout and report "
+                        "tokens/s, predicted peak bytes, KV bytes/slot, "
+                        "and max concurrent slots before OOM-by-budget "
+                        "(answers asserted byte-identical across layouts)")
+    p.add_argument("--kv_pool_mb", type=float, default=0.0,
+                   help="device-memory budget (MiB) the --kv_layout "
+                        "max-slots column is computed against (0 = the "
+                        "dense pool's own footprint, so the column reads "
+                        "as 'how many more slots fit in the same memory')")
     p.add_argument("--rows_out", type=str, default="",
                    help="append bench_rows.jsonl-compatible rows for the "
                         "--speculate_k / --prefix_reuse sweeps to this file "
@@ -249,30 +290,10 @@ def main() -> None:
             donate_argnums=(1,),  # mirrors _pool_step's jit (and the budget)
         )
 
-        class _IdTok:
-            """Tokens ARE ids ("3 17 5" -> [3, 17, 5]): the scheduler needs
-            only encode/decode/bos/eos, and a real subword vocab would just
-            blur the token accounting this sweep reports."""
-
-            bos_id, eos_id = 1, 2
-
-            def encode(self, text):
-                return [int(t) for t in text.split()]
-
-            def decode(self, toks):
-                return " ".join(str(t) for t in toks)
-
         tok = _IdTok()
-        system = rng.integers(3, args.vocab - 2, args.prompt_len)
-        reqs = [
-            {
-                "prompt": " ".join(
-                    map(str, [*system, *rng.integers(3, args.vocab - 2, 4)])
-                ),
-                "max_new": 4,
-            }
-            for _ in range(args.prefix_requests)
-        ]
+        reqs = _system_prompt_requests(
+            rng, args.vocab, args.prompt_len, args.prefix_requests
+        )
 
         results = {}
         for label, cache in (
@@ -317,6 +338,105 @@ def main() -> None:
             "predicted_peak_bytes": pool_peak,
         }
 
+    # ---- paged vs dense KV layout (continuous scheduler) ------------------
+    # Headline: KV bytes/slot and max concurrent slots under one device
+    # budget — the paged pool bounds resident KV by USED tokens, so the
+    # same memory admits more slots; answers are byte-identical either
+    # way (asserted) and tokens/s rides along for the CPU shape check.
+    kv_layouts = [x.strip() for x in args.kv_layout.split(",") if x.strip()]
+    layout_rows = []
+    if kv_layouts:
+        from transformer_tpu.analysis.costs import kv_cache_bytes, kv_pool_bytes
+        from transformer_tpu.serve import ContinuousScheduler
+        from transformer_tpu.serve.scheduler import (
+            _pool_step,
+            _pool_step_paged,
+            abstract_paged_pool,
+            abstract_pool_caches,
+        )
+
+        ltok = _IdTok()
+        lreqs = _system_prompt_requests(
+            np.random.default_rng(1), args.vocab, args.prompt_len,
+            args.prefix_requests,
+        )
+        slots = 2
+        block = args.prefix_block
+        used_tokens = args.prompt_len + 4 + 4 + 1  # prompt + tail + gen + bos
+        used_blocks = -(-used_tokens // block)
+        # Serving provisions max_total for the WORST-case request (4x this
+        # workload's typical length here); dense reserves that many rows
+        # per slot up front, paged pays only for the blocks a request
+        # actually touches — exactly the waste the cost model prices.
+        serve_total = 4 * total
+        slot_blocks = -(-serve_total // block)
+        dense_kv = kv_cache_bytes(cfg, serve_total)
+        budget_bytes = (
+            args.kv_pool_mb * (1 << 20)
+            if args.kv_pool_mb
+            else slots * dense_kv["bytes_per_slot"]
+        )
+        answers = {}
+        for layout in kv_layouts:
+            sched = ContinuousScheduler(
+                params, cfg, ltok, num_slots=slots,
+                prefill_chunk=args.chunk, kv_layout=layout, kv_block=block,
+                max_total=serve_total,
+            )
+            t0 = time.perf_counter()
+            out = sched.run([dict(r) for r in lreqs])
+            wall = time.perf_counter() - t0
+            assert all("continuation" in r for r in out), out
+            answers[layout] = [r["continuation"] for r in out]
+            new_tokens = sum(
+                len(ltok.encode(r["continuation"])) for r in out
+            )
+            if layout == "paged":
+                pool_blocks = 1 + slots * slot_blocks
+                kv = kv_pool_bytes(cfg, serve_total, slots, pool_blocks, block)
+                peak = _predict(
+                    lambda p, c, tb, ix, t: _pool_step_paged.__wrapped__(
+                        p, c, tb, ix, t, cfg, block, serve_total
+                    ),
+                    params,
+                    *abstract_paged_pool(
+                        cfg, slots, serve_total, pool_blocks, block
+                    ),
+                    jnp.zeros((slots,), jnp.int32),
+                    donate_argnums=(1,),
+                )
+                # Paged residency is per USED block: one slot costs
+                # used_blocks x block-bytes (+ its table row) — the
+                # budget admits proportionally more concurrent slots.
+                block_bytes = kv["pool_bytes"] / max(1, kv["pool_blocks"])
+                max_slots = int(budget_bytes // (used_blocks * block_bytes))
+                bytes_per_slot = int(used_blocks * block_bytes)
+            else:
+                peak = _predict(
+                    lambda p, c, t: _pool_step.__wrapped__(p, c, t, cfg),
+                    params,
+                    abstract_pool_caches(cfg, slots, serve_total),
+                    jnp.zeros((slots,), jnp.int32),
+                    donate_argnums=(1,),
+                )
+                max_slots = int(budget_bytes // dense_kv["bytes_per_slot"])
+                bytes_per_slot = dense_kv["bytes_per_slot"]
+            layout_rows.append({
+                "kv_layout": layout,
+                "tokens_per_sec": round(new_tokens / wall, 1) if wall else None,
+                "wall_s": round(wall, 3),
+                "predicted_peak_bytes": peak,
+                "kv_bytes_per_slot": bytes_per_slot,
+                "max_slots_in_budget": max_slots,
+                "budget_bytes": int(budget_bytes),
+                "used_tokens_per_slot": used_tokens,
+            })
+        first = kv_layouts[0]
+        for layout in kv_layouts[1:]:
+            assert answers[layout] == answers[first], (
+                f"kv_layout={layout} changed answers vs {first}"
+            )
+
     print(json.dumps({
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "decode_tokens_per_sec": round(decode_tok_s, 1),
@@ -331,7 +451,37 @@ def main() -> None:
         "device": f"{dev.platform}:{dev.device_kind}",
         **({"speculative": speculative} if speculative else {}),
         **({"prefix_reuse": prefix} if prefix else {}),
+        **({"kv_layouts": layout_rows} if layout_rows else {}),
     }))
+
+    if layout_rows:
+        rows = [
+            json.dumps({
+                "metric": "kv layout max concurrent slots in budget",
+                "value": r["max_slots_in_budget"],
+                "unit": "slots",
+                "config": {
+                    "layers": args.layers, "d_model": args.d_model,
+                    "heads": args.heads, "dff": args.dff,
+                    "prompt_len": args.prompt_len,
+                    "kv_layout": r["kv_layout"],
+                    "block_tokens": args.prefix_block,
+                    "budget_bytes": r["budget_bytes"],
+                },
+                "tokens_per_sec": r["tokens_per_sec"],
+                "kv_bytes_per_slot": r["kv_bytes_per_slot"],
+                "predicted_peak_bytes": r["predicted_peak_bytes"],
+                "device": f"{dev.platform}:{dev.device_kind}",
+                "vs_baseline": None,
+            })
+            for r in layout_rows
+        ]
+        if args.rows_out:
+            with open(args.rows_out, "a", encoding="utf-8") as f:
+                f.write("\n".join(rows) + "\n")
+        else:
+            for row in rows:
+                print(row, file=sys.stderr)
 
     if prefix:
         row = json.dumps({

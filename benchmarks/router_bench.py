@@ -84,6 +84,7 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
         "--serve_slots", str(args.slots),
         "--prefix_cache_mb", "32",
         "--prefix_block", str(args.prefix_block),
+        "--kv_layout", getattr(args, "kv_layout", "dense"),
         "--heartbeat_ms", "100",
     ]
     links = [ReplicaProcess.spawn(i, worker) for i in range(n_replicas)]
@@ -135,6 +136,11 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
             "requests": link.answered,
             "prefix_hit_rate": round(hit / prompt, 4) if prompt else None,
             "prefill_forwards": st.get("prefill_forwards"),
+            # Paged workers (--kv_layout paged): hit tokens restored by
+            # device-side block-table ALIASING (zero host copies) vs
+            # through a host block write.
+            "prefix_alias_tokens": st.get("prefix_alias_tokens"),
+            "host_restored_tokens": st.get("host_restored_tokens"),
             "killed": link.dead,
         }
     router.shutdown()
@@ -167,6 +173,7 @@ def run_heal(args, spec_path: str) -> dict:
         "--serve_slots", str(args.slots),
         "--prefix_cache_mb", "32",
         "--prefix_block", str(args.prefix_block),
+        "--kv_layout", getattr(args, "kv_layout", "dense"),
         "--heartbeat_ms", "100",
     ]
     n_replicas = 2
@@ -235,6 +242,10 @@ def main() -> None:
                    help="shared system-prompt length in words")
     p.add_argument("--slots", type=int, default=2)
     p.add_argument("--prefix_block", type=int, default=4)
+    p.add_argument("--kv_layout", choices=("dense", "paged"), default="dense",
+                   help="replica KV storage; 'paged' makes repeated-system-"
+                        "prompt hits device-side block-table aliases "
+                        "(prefix_alias_tokens > 0 in the row)")
     p.add_argument("--kill", action=argparse.BooleanOptionalAction,
                    default=True,
                    help="SIGKILL one replica mid-workload (replicas > 1) "
@@ -269,6 +280,10 @@ def main() -> None:
                 for r in result["per_replica"].values()
                 if r["prefix_hit_rate"] is not None
             ]
+            alias_tokens = sum(
+                int(r.get("prefix_alias_tokens") or 0)
+                for r in result["per_replica"].values()
+            )
             rows.append(json.dumps({
                 "metric": "router p99 queue latency",
                 "value": result["queue_p99_s"],
@@ -278,10 +293,15 @@ def main() -> None:
                     "requests": args.requests,
                     "system_words": args.system_words,
                     "prefix_block": args.prefix_block,
+                    "kv_layout": args.kv_layout,
                     "killed_one": result["killed_one"],
                 },
                 "requests_per_sec": result["requests_per_sec"],
                 "prefix_hit_rate_per_replica": hit_rates,
+                # The aliased hit path: > 0 means repeated system prompts
+                # were restored device-side with zero host<->device copies
+                # (paged workers only; dense workers report 0).
+                "prefix_alias_tokens": alias_tokens,
                 "redispatch_count": result["redispatch_count"],
                 "failovers": result["failovers"],
                 "device": device,

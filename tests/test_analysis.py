@@ -781,9 +781,9 @@ def test_schedules_canned_scenarios_clean():
         assert not r.violations, (r.name, [v.to_dict() for v in r.violations])
         assert not r.deadlocks, r.name
     assert {r.name for r in results} == {
-        "prefix_cache_contention", "registry_scrape_vs_create",
-        "prefetch_shutdown", "eventlog_writers", "router_dispatch_tables",
-        "supervisor_respawn",
+        "prefix_cache_contention", "kv_pool_contention",
+        "registry_scrape_vs_create", "prefetch_shutdown",
+        "eventlog_writers", "router_dispatch_tables", "supervisor_respawn",
     }
 
 

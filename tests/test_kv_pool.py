@@ -1,0 +1,452 @@
+"""Paged KV memory (``--kv_layout paged``): the block-pool allocator's
+refcount/CoW/free-list invariants, byte parity of paged vs dense serving
+across cache variants (composed with chunked prefill, speculative decoding,
+and prefix reuse incl. the aliased hit path), the zero-copy device-resident
+hit contract, pool-exhaustion degradation, and the spill-to-host ladder."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.data.tokenizer import SubwordTokenizer
+from transformer_tpu.kernels.kv_pool import KVPool, KVPoolExhausted
+from transformer_tpu.models import transformer_init
+from transformer_tpu.serve import ContinuousScheduler, PrefixCache
+from transformer_tpu.serve.prefix_cache import PrefixCorruptionError  # noqa: F401
+
+
+def _cfg(tok, **kw) -> ModelConfig:
+    base = dict(
+        num_layers=1, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=tok.model_vocab_size,
+        target_vocab_size=tok.model_vocab_size,
+        max_position=64, decoder_only=True, tie_output=True,
+        dtype="float32", dropout_rate=0.0,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return SubwordTokenizer.build_from_corpus(
+        ["ab cd ef gh ij kl mn"] * 3, target_vocab_size=300
+    )
+
+
+# The acceptance matrix: bf16, int8, GQA (the fourth variant — rolling
+# window — REFUSES the paged layout; pinned below).
+VARIANTS = {
+    "bf16": dict(dtype="bfloat16"),
+    "int8": dict(kv_cache_int8=True),
+    "gqa": dict(num_kv_heads=1),
+}
+
+# Greedy AND seeded-sampled, same prefill bucket per wave (compile-lean),
+# with wave 2 replaying wave 1's prompt as a full prefix hit plus a
+# divergent-tail partial hit.
+WAVES = [
+    [
+        {"prompt": "ab cd ef gh ij", "max_new": 6},
+        {"prompt": "ab cd ef gh kl", "max_new": 5, "temperature": 0.9,
+         "seed": 3},
+    ],
+    [
+        {"prompt": "ab cd ef gh ij", "max_new": 6},          # full hit
+        {"prompt": "ab cd ef gh mn", "max_new": 4, "temperature": 0.7,
+         "top_k": 4, "seed": 1},                             # partial hit
+    ],
+]
+
+
+def _serve(params, cfg, tok, waves, **kw):
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4, **kw
+    )
+    out = []
+    for wave in waves:
+        out.append([r for r in s.run([dict(q) for q in wave])])
+    return s, out
+
+
+# --------------------------------------------------------------------------
+# allocator units
+
+
+def test_pool_allocator_invariants():
+    pool = KVPool(8, 4, num_slots=2, slot_blocks=3)
+    assert pool.free_blocks == 7 and pool.used_blocks == 0
+    pool.ensure(0, 9)  # 3 blocks
+    assert pool.slot_tokens(0) == 12 and pool.used_blocks == 3
+    pool.check_consistency()
+    # device-tier adoption + rollback-as-truncation
+    bid = int(pool.table[0, 0])
+    pool.retain(bid)
+    assert pool.truncate(0, 5) == 1          # 3 -> 2 blocks
+    pool.check_consistency()
+    pool.free_slot(0)
+    assert pool.used_blocks == 1             # the retained block survives
+    # alias the retained block back (a prefix hit) and CoW-split it
+    j, got = pool.extend(0, bid=bid)
+    assert (j, got) == (0, bid) and pool.refs(bid) == 2
+    pairs = pool.make_writable(0, 0, 4)
+    assert len(pairs) == 1 and pairs[0][0] == bid
+    assert pool.refs(bid) == 1 and pool.stats["cow_splits"] == 1
+    pool.check_consistency()
+    # unshared blocks never split
+    assert pool.make_writable(0, 0, 4) == []
+    pool.free_slot(0)
+    assert pool.release(bid) and pool.used_blocks == 0
+    pool.check_consistency()
+    # exhaustion raises (and never corrupts the accounting): fill both
+    # slots (6 of 7 allocatable blocks), burn the last free block on one
+    # CoW split, then a second split has nowhere to go
+    pool.ensure(0, 12)
+    pool.ensure(1, 12)
+    b0 = int(pool.table[1, 0])
+    pool.retain(b0)
+    assert len(pool.make_writable(1, 0, 4)) == 1  # consumes the last free
+    b1 = int(pool.table[1, 1])
+    pool.retain(b1)
+    with pytest.raises(KVPoolExhausted):
+        pool.make_writable(1, 4, 8)
+    pool.check_consistency()
+    pool.release(b0)
+    pool.release(b1)
+    pool.free_slot(0)
+    pool.free_slot(1)
+    assert pool.used_blocks == 0
+    pool.check_consistency()
+
+
+def test_pool_table_device_upload_cached():
+    pool = KVPool(4, 2, num_slots=1, slot_blocks=2)
+    t1 = pool.table_device()
+    assert pool.table_device() is t1         # clean: no re-upload
+    pool.ensure(0, 2)
+    t2 = pool.table_device()
+    assert t2 is not t1 and int(t2[0, 0]) == int(pool.table[0, 0])
+
+
+def test_kv_pool_hammer():
+    """Real-thread contention: 4 workers drive the full serving lifecycle
+    (alloc, retain, truncate, free, alias, CoW) against one pool; the
+    accounting must re-derive exactly and every block must come home."""
+    pool = KVPool(64, 2, num_slots=4, slot_blocks=4)
+    errors = []
+
+    def worker(slot):
+        try:
+            for i in range(100):
+                pool.ensure(slot, 8)
+                bid = int(pool.table[slot, 0])
+                pool.retain(bid)
+                pool.truncate(slot, 3)
+                pool.free_slot(slot)
+                pool.extend(slot, bid=bid)
+                pool.make_writable(slot, 0, 2)
+                pool.free_slot(slot)
+                pool.release(bid)
+        except Exception as e:  # noqa: BLE001 — surfaced via the errors list
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(s,)) for s in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    pool.check_consistency()
+    assert pool.used_blocks == 0
+    assert pool.stats["cow_splits"] == 400
+
+
+# --------------------------------------------------------------------------
+# byte parity paged vs dense
+
+
+def _full_stack_parity(tok, variant: str, speculate_k: int) -> None:
+    from transformer_tpu.serve import scheduler as sched
+
+    cfg = _cfg(tok, **VARIANTS[variant])
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    common = dict(prefill_chunk=3, speculate_k=speculate_k)
+    waves = [list(WAVES[0]), list(WAVES[1])]
+    if not speculate_k:
+        # The plain path additionally pins a miss-shaped short prompt.
+        waves[0] = waves[0] + [{"prompt": "kl", "max_new": 3}]
+    _, want = _serve(
+        params, cfg, tok, waves,
+        prefix_cache=PrefixCache(cfg, block_tokens=4, budget_mb=8), **common,
+    )
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        prefix_cache=cache, kv_layout="paged", **common,
+    )
+    step_fn = sched._pool_verify_paged if speculate_k else sched._pool_step_paged
+    got = [s.run([dict(q) for q in waves[0]])]
+    # Per-STEP programs must never retrace past wave 1 (new prefill
+    # length buckets in wave 2 are a bounded compile set, exactly like
+    # dense — the full compile-set statement is paged_retrace_report's).
+    before = step_fn._cache_size()
+    got.append(s.run([dict(q) for q in waves[1]]))
+    after = step_fn._cache_size()
+    assert got == want, f"paged answers diverged from dense ({variant})"
+    assert any(r.get("continuation") for wave in got for r in wave), (
+        "vacuous parity: every continuation empty"
+    )
+    assert after == before, "steady-state recompile on the paged step"
+    # wave 2 replays wave 1's prompts: the hits must be device aliases
+    assert s.stats["prefix_hit_tokens"] > 0
+    assert s.stats["prefix_alias_tokens"] == s.stats["prefix_hit_tokens"]
+    assert s.stats["host_restored_tokens"] == 0
+    s.pool.alloc.check_consistency()
+    assert len(s._free) == 2 and not s._active
+
+
+# Tier-1/full split (wall-clock budget, same policy as the contract
+# matrix): tier-1 runs the bf16 variant composing EVERYTHING (chunked
+# prefill + speculative decoding + prefix reuse incl. aliasing) plus a
+# non-speculative bf16 pass for the plain step; the int8/GQA byte-parity
+# cross product rides the full suite below, with their storage layouts
+# still tier-1-pinned by the `paged_alias_parity` contract (analysis
+# gate) and the shared `_store_kv`/`kv_buffer_keys` write path.
+def test_paged_parity_full_stack(tok):
+    """Greedy AND seeded-sampled answers byte-identical paged vs dense,
+    composed with chunked prefill, speculative decoding, and prefix
+    reuse (incl. the aliased device-resident hit path — wave 2 replays
+    wave 1's prompts), at zero steady-state recompiles of the per-step
+    program."""
+    _full_stack_parity(tok, "bf16", speculate_k=1)
+
+
+def test_paged_parity_plain_step(tok):
+    """The non-speculative pool step (``_pool_step_paged``) byte-matches
+    dense, including a miss-shaped short prompt."""
+    _full_stack_parity(tok, "bf16", speculate_k=0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant", ["int8", "gqa"])
+@pytest.mark.parametrize("speculate_k", [0, 1])
+def test_paged_parity_variant_matrix(tok, variant, speculate_k):
+    """The remaining byte-parity cross product: int8/GQA paged vs dense,
+    plain AND speculative (full suite; bf16 rides tier-1)."""
+    _full_stack_parity(tok, variant, speculate_k=speculate_k)
+
+
+# --------------------------------------------------------------------------
+# the zero-copy aliased hit contract
+
+
+def test_aliased_hit_zero_host_copies(tok):
+    """A device-resident prefix hit is pure table aliasing: no pool-block
+    reads, no host-block writes, no model forwards for the matched
+    prefix (prefill_forwards counts only the suffix)."""
+    from transformer_tpu.serve import scheduler as sched
+
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        prefix_cache=cache, kv_layout="paged",
+    )
+    warm = s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}])
+    reads = []
+    real_reader = cache._device_reader
+    cache._device_reader = lambda bid: (reads.append(bid), real_reader(bid))[1]
+    writes = []
+    real_write = sched._pool_write_blocks
+
+    def counting_write(*a, **kw):
+        writes.append(1)
+        return real_write(*a, **kw)
+
+    sched._pool_write_blocks = counting_write
+    try:
+        replay = s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}])
+    finally:
+        sched._pool_write_blocks = real_write
+        cache._device_reader = real_reader
+    assert replay == warm
+    assert s.stats["prefix_alias_tokens"] > 0
+    assert not reads, "aliased hit paid a device->host block read"
+    assert not writes, "aliased hit paid a host->device block write"
+
+
+def test_spill_then_host_restore_then_realias(tok):
+    """Pool pressure spills device blocks to the host trie (wire format);
+    the next hit restores through ONE batched host write, is re-adopted,
+    and the hit after that aliases again — identical answers across the
+    miss / host-restored / aliased admissions."""
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        prefix_cache=cache, kv_layout="paged",
+    )
+    got = [s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}])]
+    assert cache.stats["device_blocks"] > 0
+    freed = cache.release_device_blocks(1 << 30)  # forced spill
+    assert freed > 0 and cache.stats["device_blocks"] == 0
+    assert cache.stats["spilled_blocks"] == freed
+    got.append(s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}]))
+    assert s.stats["host_restored_tokens"] > 0, "spilled hit not host-restored"
+    assert cache.stats["device_blocks"] > 0, "host restore not re-adopted"
+    alias_before = s.stats["prefix_alias_tokens"]
+    got.append(s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}]))
+    assert s.stats["prefix_alias_tokens"] > alias_before, (
+        "re-adopted block not aliased"
+    )
+    # miss, host-restored hit, and aliased hit must answer identically
+    # (dense-vs-paged parity for this path rides the full-stack matrix)
+    assert got[0] == got[1] == got[2]
+    s.pool.alloc.check_consistency()
+
+
+# --------------------------------------------------------------------------
+# degradation ladder + refusals
+
+
+def test_pool_exhaustion_preempts_with_partial(tok):
+    """A pool too small for the fleet's used tokens preempts the
+    requesting slot with a structured 'resource' answer carrying the
+    partial continuation; other requests answer normally and the pool
+    accounting survives."""
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    # 5 allocatable blocks of 4 tokens = 20 tokens for 2 slots: two
+    # long-budget requests cannot both finish.
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=24,
+        kv_layout="paged", kv_block=4, kv_pool_blocks=6,
+        admission_retries=0,
+    )
+    out = s.run([
+        {"prompt": "ab cd ef gh ij kl", "max_new": 24},
+        {"prompt": "mn ef cd ab kl ij", "max_new": 24},
+    ])
+    codes = [r.get("code") for r in out]
+    assert "resource" in codes, out
+    assert any("continuation" in r for r in out) or all(
+        r.get("code") == "resource" for r in out
+    )
+    for r in out:
+        if r.get("code") == "resource":
+            assert "partial" in r or r.get("error"), r
+    assert s.stats["kv_preempted"] >= 1
+    s.pool.alloc.check_consistency()
+    assert s.pool.alloc.used_blocks == 0 and len(s._free) == 2
+
+
+def test_admission_exhaustion_answers_transient(tok):
+    """A prompt whose prefill alone overflows the pool answers a
+    structured 'transient' error (the bounded-retry path) without
+    touching co-batched requests or leaking blocks."""
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=2,
+        kv_layout="paged", kv_block=4, kv_pool_blocks=4,
+        admission_retries=1, retry_backoff_ms=1.0,
+    )
+    out = s.run([
+        {"prompt": "ab cd ef gh ij kl mn " * 4, "max_new": 2},
+        {"prompt": "kl", "max_new": 2},
+    ])
+    assert out[0].get("code") == "transient", out[0]
+    assert "continuation" in out[1], out[1]
+    s.pool.alloc.check_consistency()
+    assert s.pool.alloc.used_blocks == 0
+
+
+def test_paged_refuses_rolling_window(tok):
+    """The windowed-refusal variant: rolling caches evict
+    absolute-position rows, so the paged pool refuses them outright."""
+    cfg = _cfg(tok, attention_window=8)
+    params = jax.eval_shape(
+        lambda k: transformer_init(k, cfg), jnp.zeros((2,), jnp.uint32)
+    )
+    with pytest.raises(ValueError, match="rolling-window"):
+        ContinuousScheduler(
+            params, cfg, tok, num_slots=2, max_total=48, kv_layout="paged"
+        )
+
+
+# --------------------------------------------------------------------------
+# kernels: block-table attention
+
+
+def test_paged_attention_matches_dense():
+    """kernels.flash_attention.paged_attention: the xla impl is bitwise
+    identical to the dense cache-path math on the same values; the flash
+    impl agrees within kernel tolerance."""
+    from transformer_tpu.kernels.flash_attention import paged_attention
+    from transformer_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(0)
+    N, B, H, D, nb = 3, 4, 2, 8, 7
+    k_pool = jnp.asarray(rng.standard_normal((nb, B, H, D)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((nb, B, H, D)), jnp.float32)
+    table = jnp.asarray([[1, 2, 0], [3, 4, 5], [6, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([7, 12, 3], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((N, 1, H, D)), jnp.float32)
+
+    dense_k = k_pool[table].reshape(N, 3 * B, H, D)
+    dense_v = v_pool[table].reshape(N, 3 * B, H, D)
+    mask = (
+        jnp.arange(3 * B)[None, None, None, :]
+        <= (lengths - 1)[:, None, None, None]
+    )
+    want, _ = dot_product_attention(q, dense_k, dense_v, mask)
+    got = paged_attention(q, k_pool, v_pool, table, lengths)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    flash = paged_attention(
+        q, k_pool, v_pool, table, lengths, impl="flash",
+        block_q=8, block_k=8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+# --------------------------------------------------------------------------
+# observability
+
+
+def test_pool_gauges_and_summarize(tok, tmp_path):
+    """serve_kv_pool_used/free_blocks gauges + the alias counter land in
+    the metrics snapshots, and ``obs summarize`` renders the
+    pool-utilization section with the alias/host split."""
+    from transformer_tpu.obs import EventLog, Telemetry
+    from transformer_tpu.obs.__main__ import render_text, summarize_events
+    from transformer_tpu.obs.events import read_events
+
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    path = tmp_path / "serve.jsonl"
+    tel = Telemetry(events=EventLog(str(path)), interval=0.0)
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        prefix_cache=cache, kv_layout="paged", telemetry=tel,
+    )
+    s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}])
+    s.run([{"prompt": "ab cd ef gh ij", "max_new": 4}])  # aliased hit
+    tel.close()
+    report = summarize_events(read_events(str(path)))
+    kv = report["serve"]["kv_pool"]
+    assert kv["used_blocks"] is not None and kv["samples"] > 0
+    assert kv["alias_tokens"] > 0 and kv["host_restored_tokens"] == 0
+    assert kv["alias_rate"] == 1.0
+    text = render_text(report)
+    assert "kv pool:" in text and "device-aliased" in text

@@ -256,6 +256,7 @@ def _cmd_contracts(args: argparse.Namespace) -> int:
 def _cmd_retrace(args: argparse.Namespace) -> int:
     from transformer_tpu.analysis.retrace import (
         decode_retrace_report,
+        paged_retrace_report,
         prefix_cache_retrace_report,
         resilience_retrace_report,
         speculative_retrace_report,
@@ -266,6 +267,7 @@ def _cmd_retrace(args: argparse.Namespace) -> int:
         decode_retrace_report(steps=args.steps)
         + speculative_retrace_report(steps=args.steps)
         + prefix_cache_retrace_report(steps=args.steps)
+        + paged_retrace_report(steps=args.steps)
         + resilience_retrace_report(steps=args.steps)
         + train_retrace_report(steps=args.steps)
     )

@@ -300,6 +300,76 @@ def check_prefix_restore_parity(
     )
 
 
+def check_paged_alias_parity(
+    cfg: ModelConfig, num_slots: int = 2, max_total: int = 16, block: int = 4
+) -> str:
+    """Paged-KV structural parity (the aliased-restore sibling of
+    ``prefix_restore_parity``): (1) the per-slot views the paged step
+    gathers through the block tables must be pytree/shape/dtype identical
+    to the DENSE slot pool the model forward was written against — the
+    precondition of byte-identical answers across ``--kv_layout``; (2) a
+    restore through the pool — the host-block scatter write (an ALIASED
+    device-tier hit is a pure table op and cannot perturb the pool by
+    construction) — must leave the pool structurally indistinguishable
+    from a chunked prefill over the same tokens, across plain/int8/GQA
+    layouts (rolling windows are refused by the paged pool)."""
+    import numpy as np
+
+    from transformer_tpu.serve.scheduler import (
+        _paged_views,
+        _pool_write_blocks,
+        _slot_prefill_paged,
+        abstract_paged_pool,
+        abstract_pool_caches,
+    )
+
+    pool_blocks = 1 + num_slots * (-(-max_total // block))
+    pool, table, index = abstract_paged_pool(
+        cfg, num_slots, max_total, pool_blocks, block
+    )
+    dense = abstract_pool_caches(cfg, num_slots, max_total)
+    views = jax.eval_shape(
+        lambda p, t, i: _paged_views(p, t, i, max_total), pool, table, index
+    )
+    a, b = _tree_spec(views), _tree_spec(dense)
+    assert a == b, (
+        "gathered paged views diverge from the dense slot pool:\n"
+        f"  dense: {b}\n  paged: {a}"
+    )
+
+    params = abstract_params(cfg)
+    n_blocks, n = 2, 2 * block
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)  # noqa: E731
+    after_prefill = jax.eval_shape(
+        lambda p, c, tb, s, pr, st: _slot_prefill_paged(
+            p, c, tb, s, pr, st, cfg, block, block, max_total
+        )[1],
+        params, pool, table, i32(), _ids(1, n), i32(),
+    )
+    host_blocks = [
+        {
+            key: jax.ShapeDtypeStruct(
+                (n_blocks, block) + leaf.shape[2:], leaf.dtype
+            )
+            for key, leaf in layer.items()
+        }
+        for layer in pool
+    ]
+    after_restore = jax.eval_shape(
+        _pool_write_blocks, pool, i32(n_blocks), host_blocks
+    )
+    p_spec = _tree_spec(after_prefill)
+    r_spec = _tree_spec(after_restore)
+    assert p_spec == r_spec == _tree_spec(list(pool)), (
+        "restore and chunked prefill disagree on the pool layout:\n"
+        f"  prefill: {p_spec}\n  restore: {r_spec}"
+    )
+    return (
+        f"{len(a)} view leaves dense-identical; pool layout stable across "
+        f"restore/prefill ({n_blocks}x{block}-token blocks)"
+    )
+
+
 def _walk_eqns(jaxpr) -> Iterable:
     """Every equation, recursing through pjit/scan/while/cond sub-jaxprs."""
     for eqn in jaxpr.eqns:
@@ -673,6 +743,15 @@ _CONTRACTS: list[tuple[str, Callable[[ModelConfig], str], Callable[[ModelConfig]
     (
         "prefix_restore_parity",
         check_prefix_restore_parity,
+        lambda c: c.decoder_only and not c.attention_window,
+    ),
+    # The paged pool refuses rolling windows for the same reason the
+    # prefix cache does; every other LM cache variant must gather views
+    # dense-identical and keep the pool layout stable across restore and
+    # prefill.
+    (
+        "paged_alias_parity",
+        check_paged_alias_parity,
         lambda c: c.decoder_only and not c.attention_window,
     ),
     ("softmax_f32", check_softmax_f32, lambda c: True),

@@ -335,6 +335,43 @@ def kv_cache_bytes(cfg, max_total: int) -> dict[str, Any]:
     }
 
 
+def kv_pool_bytes(
+    cfg, max_total: int, num_slots: int, pool_blocks: int, block_tokens: int
+) -> dict[str, Any]:
+    """Device bytes of the PAGED pool amortized per slot: the pool is
+    shared, so bytes/slot = pool bytes / slots — the number that must be
+    SMALLER than the dense ``kv_cache_bytes`` figure whenever the pool is
+    provisioned below ``slots x max_total`` (the refactor's banked win;
+    gated per paged program via ``kv_bytes_per_slot``)."""
+    import jax
+
+    from transformer_tpu.ops.attention import init_block_pool, kv_buffer_keys
+
+    pool = jax.eval_shape(
+        lambda: [
+            init_block_pool(
+                pool_blocks, block_tokens, cfg.kv_heads, cfg.head_dim,
+                cfg.compute_dtype, quantize=cfg.kv_cache_int8,
+            )
+            for _ in range(cfg.num_layers)
+        ]
+    )
+    total = sum(
+        _aval_bytes(layer[key]) for layer in pool for key in kv_buffer_keys(layer)
+    )
+    return {
+        "bytes_per_slot": int(total // max(1, num_slots)),
+        "bytes_per_token": int(
+            total // max(1, pool_blocks * block_tokens)
+        ),
+        "pool_bytes": int(total),
+        "pool_blocks": pool_blocks,
+        "block_tokens": block_tokens,
+        "max_total": max_total,
+        "layers": len(pool),
+    }
+
+
 # ==========================================================================
 # canned programs
 
@@ -344,10 +381,19 @@ _SERVE_TOTAL = 32
 _VERIFY_W = 4
 _PREFILL_LEN = 8
 _RESTORE_BLOCK = 4
+# Paged-pool canned sizing (the banked WIN): blocks of _PAGED_BLOCK tokens,
+# pool provisioned for HALF the dense worst case — slot cost proportional
+# to used tokens is the whole point, and the budget gate fails if a
+# regression re-densifies it (kv_bytes_per_slot increase).
+_PAGED_BLOCK = 8
+_PAGED_POOL_BLOCKS = 1 + _SERVE_SLOTS * (_SERVE_TOTAL // 2 // _PAGED_BLOCK)
 
 # The serving cache variants (analysis/configs.py FAST_MATRIX): plain bf16,
 # int8+scales, rolling window, grouped-query.
 SERVE_VARIANTS = ("lm_bf16", "lm_int8_cache", "lm_window", "lm_gqa")
+# Paged layout refuses rolling windows (absolute-position rows are evicted
+# on wrap) — the other three variants store their layouts inside blocks.
+PAGED_VARIANTS = ("lm_bf16", "lm_int8_cache", "lm_gqa")
 
 
 def _abstract_model(cfg):
@@ -390,6 +436,49 @@ def canned_cost_reports() -> tuple[list[CostReport], list[str]]:
         kv = kv_cache_bytes(cfg, _SERVE_TOTAL)
         r.extras["kv_bytes_per_slot"] = kv["bytes_per_slot"]
         reports.append(r)
+
+    # -- the PAGED decode hot loop, per non-rolling variant -----------------
+    # kv_bytes_per_slot here is the banked paged-KV win: the pool is
+    # provisioned for half the dense worst case, so a regression that
+    # re-densifies the layout (or silently re-inflates the pool) fails the
+    # budget gate the moment it lands.
+    from transformer_tpu.serve.scheduler import abstract_paged_pool
+
+    for variant in PAGED_VARIANTS:
+        cfg = FAST_MATRIX[variant]
+        params = _abstract_model(cfg)
+        pool, table, index = abstract_paged_pool(
+            cfg, _SERVE_SLOTS, _SERVE_TOTAL, _PAGED_POOL_BLOCKS, _PAGED_BLOCK
+        )
+        step_raw = sched._pool_step_paged.__wrapped__
+        r = program_costs(
+            f"serve.pool_step_paged[{variant}]",
+            lambda p, c, tb, ix, t: step_raw(
+                p, c, tb, ix, t, cfg, _PAGED_BLOCK, _SERVE_TOTAL
+            ),
+            params, pool, table, index, i32(_SERVE_SLOTS),
+            donate_argnums=(1,),
+        )
+        r.extras["kv_bytes_per_slot"] = kv_pool_bytes(
+            cfg, _SERVE_TOTAL, _SERVE_SLOTS, _PAGED_POOL_BLOCKS, _PAGED_BLOCK
+        )["bytes_per_slot"]
+        reports.append(r)
+
+    cfg = FAST_MATRIX["lm_bf16"]
+    params = _abstract_model(cfg)
+    pool, table, index = abstract_paged_pool(
+        cfg, _SERVE_SLOTS, _SERVE_TOTAL, _PAGED_POOL_BLOCKS, _PAGED_BLOCK
+    )
+    prefill_paged_raw = sched._slot_prefill_paged.__wrapped__
+    reports.append(
+        program_costs(
+            f"serve.slot_prefill_paged[lm_bf16,n={_PREFILL_LEN}]",
+            lambda p, c, tb, s, pr, st: prefill_paged_raw(
+                p, c, tb, s, pr, st, cfg, 0, _PAGED_BLOCK, _SERVE_TOTAL
+            ),
+            params, pool, table, i32(), i32(1, _PREFILL_LEN), i32(),
+        )
+    )
 
     # -- admission, verify, restore (plain variant: the structural shapes
     # are identical across variants; the per-variant BYTES are covered by
@@ -654,6 +743,13 @@ def run_costs(
         variant: kv_cache_bytes(FAST_MATRIX[variant], _SERVE_TOTAL)
         for variant in SERVE_VARIANTS
     }
+    kv.update({
+        f"{variant}_paged": kv_pool_bytes(
+            FAST_MATRIX[variant], _SERVE_TOTAL, _SERVE_SLOTS,
+            _PAGED_POOL_BLOCKS, _PAGED_BLOCK,
+        )
+        for variant in PAGED_VARIANTS
+    })
     regressions: list[str] = []
     notes: list[str] = []
     if compare:
@@ -688,10 +784,19 @@ def summarize(result: CostsResult) -> str:
             f"(intensity {r.intensity}), collectives: {coll}"
         )
     for variant, entry in sorted(result.kv.items()):
+        if "pool_blocks" in entry:
+            geom = (
+                f"pool {entry['pool_blocks']} x {entry['block_tokens']}-token "
+                f"blocks, max_total {entry['max_total']}"
+            )
+        else:
+            geom = (
+                f"buffer {entry['buffer_tokens']} of max_total "
+                f"{entry['max_total']}"
+            )
         lines.append(
             f"kv_cache[{variant}]: {_fmt_bytes(entry['bytes_per_slot'])}/slot, "
-            f"{_fmt_bytes(entry['bytes_per_token'])}/token "
-            f"(buffer {entry['buffer_tokens']} of max_total {entry['max_total']})"
+            f"{_fmt_bytes(entry['bytes_per_token'])}/token ({geom})"
         )
     for s in result.skipped:
         lines.append(f"SKIP {s} (needs >= 2 devices)")

@@ -276,6 +276,82 @@ def prefix_cache_retrace_report(steps: int = 3) -> list[WatchDelta]:
     return sentinel.deltas()
 
 
+def paged_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state serving on the PAGED KV layout (``--kv_layout paged``)
+    across every admission outcome the block pool can produce — fresh
+    allocations, frees at retirement, device-tier ALIAS hits, spill-to-host
+    followed by host-restore (re-adopted back into the device tier), and a
+    copy-on-write block split — while the hot paths
+    (``_pool_step_paged``, ``_slot_prefill_paged``, ``_pool_write_blocks``,
+    ``_pool_read_block``, ``_pool_copy_blocks``, ``_pick_pool``) compile
+    ZERO new programs after warmup: table/index shapes are static, host
+    restores pad to power-of-two block counts, and per-slot indices are
+    host-derived, so no pool state may mint a fresh shape. Greedy answers
+    are asserted byte-identical round over round."""
+    from transformer_tpu.serve import PrefixCache
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg, params, tok = _tiny_lm_setup()
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        prefix_cache=cache, kv_layout="paged",
+    )
+    wave = [
+        {"prompt": "the quick brown fox jumps"},
+        {"prompt": "the quick brown dog"},
+    ]
+
+    def one_round():
+        out = s.run([dict(r) for r in wave])       # miss / alias / partial
+        # Spill rung: push every device-tier block to the host trie (the
+        # wire format), then re-serve — hits now restore through the
+        # batched host write and are re-adopted, so the NEXT round
+        # aliases again. Exercises _pool_read_block + _pool_write_blocks.
+        s.stats["kv_spilled_blocks"] += cache.release_device_blocks(1 << 30)
+        out2 = s.run([dict(r) for r in wave])
+        # CoW rung: alias a device-tier block into a free slot's table
+        # (refcount 2) and write-guard it — the pool splits the block and
+        # copies it on device (_pool_copy_blocks), the fork a
+        # parallel-sampling tier drives per step. The row is returned
+        # before any admission can see it.
+        bid = None
+        with cache._lock:
+            stack = [cache._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n.device_block is not None:
+                    bid = n.device_block
+                    break
+        if bid is not None:
+            slot = s._free[-1]
+            s.pool.alloc.extend(slot, bid=bid)
+            s._paged_cow(slot, 0, cache.block_tokens)
+            s.pool.alloc.free_slot(slot)
+        s.pool.alloc.check_consistency()
+        return [r.get("continuation") for r in out + out2]
+
+    # ONE warmup round compiles every shape steady state sees: the round
+    # itself covers miss -> spill -> host-restore -> re-adopt -> CoW, and
+    # the first steady round's alias hits reuse the restore-round's
+    # suffix buckets (aliasing is a host-side table op).
+    want = one_round()
+    sentinel = RetraceSentinel()
+    sentinel.watch("decode(_pool_step_paged)", sched._pool_step_paged, budget=0)
+    sentinel.watch("_slot_prefill_paged", sched._slot_prefill_paged, budget=0)
+    sentinel.watch("restore(_pool_write_blocks)", sched._pool_write_blocks, budget=0)
+    sentinel.watch("spill(_pool_read_block)", sched._pool_read_block, budget=0)
+    sentinel.watch("cow(_pool_copy_blocks)", sched._pool_copy_blocks, budget=0)
+    sentinel.watch("pick(_pick_pool)", sched._pick_pool, budget=0)
+    sentinel.snapshot()
+    for i in range(steps):
+        got = one_round()
+        assert got == want, f"paged round {i} changed greedy answers"
+    return sentinel.deltas()
+
+
 def resilience_retrace_report(steps: int = 3) -> list[WatchDelta]:
     """Steady-state serving WHILE circuit breakers flip: injected drafter
     and prefix-cache faults open the breakers mid-run, requests keep

@@ -818,6 +818,50 @@ def _scenario_prefix_cache(sched: DetScheduler):
     return [hammer(a), hammer(b)], check
 
 
+def _scenario_kv_pool(sched: DetScheduler):
+    """Two slots hammer one paged-KV allocator (kernels/kv_pool.py)
+    through the full serving lifecycle — alloc (admission), device-tier
+    retain (retirement donation), truncate (speculative rollback), free
+    (slot recycle), alias (prefix hit), copy-on-write split (divergent
+    write under sharing) — under preemption at every line. Invariants
+    (``check_consistency`` re-derives the accounting from first
+    principles after every step): refcounts never negative, no
+    double-free, free list disjoint from every table, block-count
+    conservation."""
+    from transformer_tpu.kernels.kv_pool import KVPool
+
+    pool = KVPool(8, 2, num_slots=2, slot_blocks=3)
+
+    def worker(slot: int):
+        def body():
+            pool.ensure(slot, 6)                    # admission: 3 blocks
+            pool.check_consistency()
+            bid = int(pool.table[slot, 0])          # row owned by this thread
+            pool.retain(bid)                        # trie adopts block 0
+            pool.check_consistency()
+            pool.truncate(slot, 2)                  # rollback to 1 block
+            pool.check_consistency()
+            pool.free_slot(slot)                    # retire: pin survives
+            pool.check_consistency()
+            pool.extend(slot, bid=bid)              # prefix hit: alias back
+            pairs = pool.make_writable(slot, 0, 2)  # CoW: refs 2 -> split
+            assert len(pairs) == 1, f"expected one CoW split, got {pairs}"
+            pool.check_consistency()
+            pool.free_slot(slot)
+            pool.release(bid)                       # trie eviction
+            pool.check_consistency()
+        return body
+
+    def check():
+        pool.check_consistency()
+        assert pool.used_blocks == 0, (
+            f"blocks leaked: {pool.stats}, table {pool.table.tolist()}"
+        )
+        assert pool.stats["cow_splits"] == 2, pool.stats
+
+    return [worker(0), worker(1)], check
+
+
 def _scenario_registry(sched: DetScheduler, registry_factory=None):
     from transformer_tpu.obs.registry import MetricsRegistry
 
@@ -1124,6 +1168,13 @@ CANNED: dict[str, Scenario] = {
         setup=_scenario_prefix_cache,
         modules=lambda: _pkg_modules("transformer_tpu.serve.prefix_cache"),
         instrument=lambda: _pkg_files("transformer_tpu.serve.prefix_cache"),
+        max_schedules=64,
+    ),
+    "kv_pool_contention": Scenario(
+        name="kv_pool_contention",
+        setup=_scenario_kv_pool,
+        modules=lambda: _pkg_modules("transformer_tpu.kernels.kv_pool"),
+        instrument=lambda: _pkg_files("transformer_tpu.kernels.kv_pool"),
         max_schedules=64,
     ),
     "registry_scrape_vs_create": Scenario(

@@ -74,6 +74,15 @@ def define_router_flags() -> None:
     flags.DEFINE_integer("prefix_cache_mb", 64,
                          "per-replica prefix KV cache budget (0 = off)")
     flags.DEFINE_integer("prefix_block", 16, "prefix-cache block tokens")
+    flags.DEFINE_enum(
+        "kv_layout", "dense", ["dense", "paged"],
+        "per-slot KV storage in each replica worker: dense buffers or the "
+        "paged block pool with device-resident prefix aliasing "
+        "(docs/SERVING.md)")
+    flags.DEFINE_integer(
+        "kv_pool_blocks", 0,
+        "paged pool size per replica, in --prefix_block-token blocks "
+        "(0 = full provisioning)")
     flags.DEFINE_integer(
         "affinity_block", 0,
         "token-block granularity for prefix-affinity hashing "
@@ -159,6 +168,8 @@ def worker_args_from_flags(replica_jsonl: str = "") -> list[str]:
         "--speculate_k", str(FLAGS.speculate_k),
         "--prefix_cache_mb", str(FLAGS.prefix_cache_mb),
         "--prefix_block", str(FLAGS.prefix_block),
+        "--kv_layout", FLAGS.kv_layout,
+        "--kv_pool_blocks", str(FLAGS.kv_pool_blocks),
         "--heartbeat_ms", str(FLAGS.heartbeat_ms),
     ]
     if FLAGS.model_spec:

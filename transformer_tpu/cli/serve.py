@@ -128,6 +128,22 @@ def define_serve_flags() -> None:
         "(corrupt blocks are dropped instead of silently restored — "
         "docs/ROBUSTNESS.md). Costs O(matched KV bytes) of host CPU per "
         "hit; disable to trade integrity checking for admission latency")
+    flags.DEFINE_enum(
+        "kv_layout", "dense", ["dense", "paged"],
+        "per-slot KV storage for the continuous-batching path: 'dense' "
+        "reserves max_total rows per slot (the historical layout); "
+        "'paged' backs every slot from ONE device-resident block pool "
+        "through per-slot block tables (kernels/kv_pool.py) — resident KV "
+        "proportional to used tokens, prefix-cache hits restored by "
+        "block-table aliasing with zero host copies, byte-identical "
+        "answers either way. Incompatible with attention_window")
+    flags.DEFINE_integer(
+        "kv_pool_blocks", 0,
+        "paged KV pool size in blocks of --prefix_block tokens (0 = full "
+        "provisioning: every slot can always reach --serve_max_total). "
+        "Smaller pools bound resident KV by used tokens; under pressure "
+        "the device-resident prefix tier spills to host and, as the last "
+        "rung, the requesting slot answers a structured 'resource' error")
     flags.DEFINE_integer(
         "max_backlog", 0,
         "bounded admission backpressure for the continuous-batching path: "
@@ -496,13 +512,28 @@ def main(argv) -> None:
             FLAGS.serve_max_total or model_cfg.max_position + 1
         ) + max(0, FLAGS.speculate_k)
         kv = kv_cache_bytes(model_cfg, pool_tokens)
-        logging.info(
-            "slot pool KV budget: %d slots x %d bytes/slot = %.1f MiB "
-            "(%d bytes/token, dense max_len layout)",
-            FLAGS.serve_slots, kv["bytes_per_slot"],
-            FLAGS.serve_slots * kv["bytes_per_slot"] / (1 << 20),
-            kv["bytes_per_token"],
-        )
+        if FLAGS.kv_layout == "paged":
+            blk = FLAGS.prefix_block
+            slot_blocks = -(-pool_tokens // blk)
+            n_blocks = FLAGS.kv_pool_blocks or (
+                1 + FLAGS.serve_slots * slot_blocks
+            )
+            pool_bytes = n_blocks * blk * kv["bytes_per_token"]
+            logging.info(
+                "paged KV pool budget: %d blocks x %d tokens = %.1f MiB "
+                "(%d bytes/token; dense layout would reserve %.1f MiB)",
+                n_blocks, blk, pool_bytes / (1 << 20),
+                kv["bytes_per_token"],
+                FLAGS.serve_slots * kv["bytes_per_slot"] / (1 << 20),
+            )
+        else:
+            logging.info(
+                "slot pool KV budget: %d slots x %d bytes/slot = %.1f MiB "
+                "(%d bytes/token, dense max_len layout)",
+                FLAGS.serve_slots, kv["bytes_per_slot"],
+                FLAGS.serve_slots * kv["bytes_per_slot"] / (1 << 20),
+                kv["bytes_per_token"],
+            )
         sched = ContinuousScheduler(
             params, model_cfg, tgt_tok,
             num_slots=FLAGS.serve_slots,
@@ -514,6 +545,9 @@ def main(argv) -> None:
             drafter=drafter,
             prefix_cache=prefix_cache,
             max_backlog=FLAGS.max_backlog,
+            kv_layout=FLAGS.kv_layout,
+            kv_block=FLAGS.prefix_block,
+            kv_pool_blocks=FLAGS.kv_pool_blocks,
             admission_retries=FLAGS.admission_retries,
             breaker_threshold=FLAGS.breaker_threshold,
             breaker_cooldown_s=FLAGS.breaker_cooldown,

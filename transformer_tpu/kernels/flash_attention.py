@@ -768,3 +768,79 @@ def flash_attention(
     out = _flash(cfg, fold(q), fold(k), fold(v), mask_i32)
     out = out.reshape(b, h, s_q_pad, d).transpose(0, 2, 1, 3)
     return out[:, :s_q] if pad_q else out
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) attention: the kernel-facing entry of the paged KV
+# pool (kernels/kv_pool.py). K/V live in ONE (num_blocks, B, H_kv, D) pool
+# per layer; each sequence addresses its blocks through a table row, so
+# resident KV is proportional to used tokens and a shared prefix is the
+# same physical blocks in two tables. This function gathers K/V through
+# the table and attends the valid prefix — the dense path stays available
+# behind the same serving interface (--kv_layout dense), and the fused
+# Pallas decode kernel that reads blocks in place (no gathered view) is
+# the ROADMAP's next kernel item.
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    table: jax.Array,
+    lengths: jax.Array,
+    *,
+    impl: str = "xla",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Attention over a paged KV pool through per-sequence block tables.
+
+    Args:
+      q: (N, S_q, H, D) queries; row ``s`` sits at absolute positions
+        ``lengths[s] - S_q .. lengths[s] - 1`` (decode: S_q = 1 at the
+        newest position, already written into the pool).
+      k_pool, v_pool: (num_blocks, B, H_kv, D) pool buffers (bf16/fp32;
+        int8 pools dequantize before calling — the serving path fuses the
+        dequant into its gathered view).
+      table: (N, nmax) int32 block table (``kernels/kv_pool.KVPool``).
+      lengths: (N,) int32 valid KV length per sequence — positions
+        ``>= lengths[s]`` (stale rows, sink gathers) are masked out.
+      impl: "xla" — bitwise-identical math to the dense cache path
+        (gather + fp32-softmax ``dot_product_attention``); "flash" — the
+        Pallas blockwise kernel over the gathered view (decode S_q=1
+        only: its key-padding mask carries no per-row causality).
+
+    Returns (N, S_q, H, D) attention outputs in q's dtype.
+    """
+    from transformer_tpu.kernels.kv_pool import gather_block_views
+
+    n, s_q = q.shape[:2]
+    k = gather_block_views(k_pool, table)  # (N, L, H_kv, D)
+    v = gather_block_views(v_pool, table)
+    L = k.shape[1]
+    if impl == "flash":
+        if s_q != 1:
+            raise ValueError(
+                "paged_attention impl='flash' serves decode (S_q = 1): its "
+                "key-padding mask cannot express per-row offset causality"
+            )
+        kv_mask = jnp.arange(L)[None, :] < lengths[:, None]
+        return flash_attention(
+            q, k, v, kv_mask=kv_mask, causal=False,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    if impl != "xla":
+        raise ValueError(f"unknown paged_attention impl {impl!r}")
+    from transformer_tpu.ops.attention import dot_product_attention
+
+    # The offset causal mask of make_cache_prefix_mask, batched per
+    # sequence: query i (absolute position lengths - s_q + i) attends
+    # pool position j iff j <= that position.
+    positions = jnp.arange(L)[None, None, None, :]
+    q_pos = (lengths[:, None, None, None] - s_q) + jnp.arange(s_q)[
+        None, None, :, None
+    ]
+    out, _ = dot_product_attention(q, k, v, positions <= q_pos)
+    return out

@@ -276,6 +276,41 @@ def summarize_events(events: list[dict]) -> dict:
         step_hist = last.get("serve_step_seconds")
         if isinstance(step_hist, dict) and step_hist.get("count"):
             report.setdefault("serve", {})["step_seconds"] = step_hist
+        # Paged KV pool utilization (--kv_layout paged): block occupancy
+        # over the run from the used/free gauges, plus the aliased-vs-
+        # host-restored split of the prefix hit tokens (aliased hits paid
+        # ZERO host<->device copies).
+        pool_utils = []
+        for s in snaps:
+            m = s.get("metrics", {})
+            used, free = (
+                m.get("serve_kv_pool_used_blocks"),
+                m.get("serve_kv_pool_free_blocks"),
+            )
+            if isinstance(used, (int, float)) and isinstance(
+                free, (int, float)
+            ) and used + free > 0:
+                pool_utils.append(used / (used + free))
+        if pool_utils:
+            kv_pool = {
+                "used_blocks": last.get("serve_kv_pool_used_blocks"),
+                "free_blocks": last.get("serve_kv_pool_free_blocks"),
+                "utilization_mean": round(
+                    sum(pool_utils) / len(pool_utils), 4
+                ),
+                "utilization_max": round(max(pool_utils), 4),
+                "samples": len(pool_utils),
+            }
+            alias = last.get("serve_prefix_alias_tokens_total")
+            hit = last.get("serve_prefix_hit_tokens_total")
+            if isinstance(alias, (int, float)) and isinstance(
+                hit, (int, float)
+            ):
+                kv_pool["alias_tokens"] = int(alias)
+                kv_pool["host_restored_tokens"] = int(hit - alias)
+                if hit:
+                    kv_pool["alias_rate"] = round(alias / hit, 4)
+            report.setdefault("serve", {})["kv_pool"] = kv_pool
 
     # ---- train: throughput + step-time quantiles -------------------------
     windows = [e for e in events if e.get("kind") == "train.window"]
@@ -415,6 +450,25 @@ def render_text(report: dict) -> str:
                 f"  prefix cache: {pc['hit_tokens']}/{pc['prompt_tokens']} "
                 f"prompt tokens reused{rate} over {pc['requests']} requests"
             )
+        kv = serve.get("kv_pool")
+        if kv:
+            lines.append(
+                f"  kv pool: {kv.get('used_blocks')} used / "
+                f"{kv.get('free_blocks')} free blocks, utilization mean "
+                f"{kv['utilization_mean'] * 100:.1f}% max "
+                f"{kv['utilization_max'] * 100:.1f}% over "
+                f"{kv['samples']} samples"
+            )
+            if kv.get("alias_tokens") is not None:
+                rate = (
+                    f" ({kv['alias_rate'] * 100:.1f}% aliased)"
+                    if kv.get("alias_rate") is not None else ""
+                )
+                lines.append(
+                    f"  prefix restore split: {kv['alias_tokens']} tokens "
+                    f"device-aliased (zero copies) vs "
+                    f"{kv['host_restored_tokens']} host-restored{rate}"
+                )
         spec = serve.get("speculative")
         if spec:
             q = spec.get("request_acceptance") or {}

@@ -529,6 +529,39 @@ def rollback_cache(cache: dict[str, Any], index) -> dict[str, Any]:
     return dict(cache, index=jnp.asarray(index, jnp.int32))
 
 
+def init_block_pool(
+    num_blocks: int,
+    block_tokens: int,
+    num_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+    quantize: bool = False,
+) -> dict[str, Any]:
+    """One layer's PAGED KV pool: the per-position buffers of
+    ``init_cache``, re-shaped from one (B, max_len, H, D) run per slot
+    into a single (num_blocks, block_tokens, H, D) pool every slot
+    addresses through a block table (``kernels/kv_pool.py``). Buffer KEYS
+    and storage layouts are identical to the dense cache's — int8 codes
+    with fp32 scales, GQA kv-head counts — so ``kv_buffer_keys`` iterates
+    both, a pool block read IS a host-format prefix-cache block, and the
+    dense <-> paged round trip is bit-transparent. No ``index`` (per-slot
+    position bookkeeping lives with the table) and no rolling variant
+    (rolling windows evict absolute-position rows — the same refusal the
+    prefix cache and speculative rollback enforce)."""
+    shape = (num_blocks, block_tokens, num_heads, head_dim)
+    if quantize:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(shape[:3] + (1,), dtype=jnp.float32),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "v_scale": jnp.zeros(shape[:3] + (1,), dtype=jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, dtype=dtype),
+        "v": jnp.zeros(shape, dtype=dtype),
+    }
+
+
 def init_cache(
     batch_size: int,
     max_len: int,

@@ -40,6 +40,20 @@ Mechanics:
   childless nodes are candidates (evicting an interior node would orphan
   its descendants — a trie walk could never reach them again).
 
+**Device-resident tier** (paged serving, ``--kv_layout paged`` —
+docs/SERVING.md "Paged KV memory"): when the scheduler attaches its
+block-pool allocator (``attach_device_pool``), trie nodes may hold a
+refcounted DEVICE block id instead of (or alongside) host bytes. A
+retiring slot donates its prompt blocks by reference
+(``insert_device`` — no device read, no host copy) and a later hit
+restores by block-table ALIASING (``PrefixHit.paged_plan``) — zero
+model forwards and zero host<->device copies. Pool pressure spills LRU
+device blocks back to the host tier in the SAME host block format
+(``release_device_blocks``), so the wire/spill surface — disaggregated
+KV handoff, supervisor cache warming (``host_blocks_for``) — is
+unchanged. Host-tier hits pay one batched device write and are
+re-adopted (``adopt_device``), so the next hit aliases.
+
 Rolling-window caches are refused at construction (same policy as
 speculative rollback): a rolling buffer stores position ``p`` at slot
 ``p % buf_len`` and evicts on wrap, so absolute-position block rows are
@@ -98,11 +112,17 @@ def _block_crc(blocks: list[dict[str, np.ndarray]]) -> int:
 class _Node:
     """One trie node = one KV block: per-layer buffer rows for the
     ``block_tokens`` positions this node's depth covers, for every prompt
-    sharing the root-to-here token path."""
+    sharing the root-to-here token path. With the device tier attached
+    (paged serving), a node may instead (or additionally) hold
+    ``device_block`` — a refcounted id into the serving pool's
+    device-resident block pool (``kernels/kv_pool.py``); hits on such
+    nodes restore by block-table aliasing with zero host<->device
+    copies, and the host ``blocks`` form is materialized lazily on spill
+    or wire export."""
 
     __slots__ = (
         "children", "parent", "edge", "blocks", "nbytes", "last_used",
-        "refs", "crc",
+        "refs", "crc", "device_block",
     )
 
     def __init__(self, parent: "_Node | None", edge: tuple[int, ...]):
@@ -114,6 +134,7 @@ class _Node:
         self.last_used = 0
         self.refs = 0
         self.crc = 0
+        self.device_block: int | None = None
 
 
 @dataclasses.dataclass
@@ -160,6 +181,17 @@ class PrefixHit:
                 per_key[key] = np.concatenate(parts, axis=1)
             out.append(per_key)
         return out
+
+    def paged_plan(self) -> "list[tuple[_Node, int | None, list | None]]":
+        """Per matched node, the paged restore source: ``(node,
+        device_block_id, host_blocks)`` — alias the device block when one
+        exists (zero copies), else scatter-write the host payload into a
+        fresh pool block (the scheduler then re-adopts it via
+        :meth:`PrefixCache.adopt_device`, so the NEXT hit aliases). Safe
+        without the lock: the nodes are pinned, pinned nodes are never
+        spilled (``release_device_blocks`` skips them) or evicted, and
+        both payload forms are immutable while attached."""
+        return [(n, n.device_block, n.blocks) for n in self._nodes]
 
     def release(self) -> None:
         with self._cache._lock:
@@ -216,12 +248,160 @@ class PrefixCache:
         self._clock = 0
         self._bytes = 0
         self._bytes_per_block = 0  # learned from the first inserted block
+        # Device-resident tier (paged serving): the pool allocator whose
+        # refcounts device blocks live under, and the reader that fetches
+        # one block to host format (spill / wire export). Attached by the
+        # scheduler via attach_device_pool; None = host-only (dense).
+        self._pool = None
+        self._device_reader = None
         self.stats = {
             "blocks": 0,
             "inserted_blocks": 0,
             "evicted_blocks": 0,
             "corrupt_blocks": 0,
+            "device_blocks": 0,
+            "spilled_blocks": 0,
         }
+
+    # ---- device-resident tier (paged serving) -----------------------------
+
+    def attach_device_pool(self, pool, reader) -> None:
+        """Enable the device tier: ``pool`` is the serving scheduler's
+        ``kernels.kv_pool.KVPool`` (the refcount authority for device
+        blocks) and ``reader(block_id)`` fetches one pool block to the
+        host block format (used only for spill-under-pressure and wire
+        exports — the hit path is pure table aliasing)."""
+        with self._lock:
+            self._pool = pool
+            self._device_reader = reader
+
+    def insert_device(
+        self, ids: Sequence[int], n_tokens: int, block_ids: Sequence[int]
+    ) -> int:
+        """Adopt a retiring slot's device blocks for the first
+        ``floor(n_tokens / B) * B`` positions of ``ids``: each missing
+        trie node takes a pool reference on its block (``block_ids[j]``)
+        — NO device read, NO host copy. Nodes the trie already holds just
+        refresh recency (and adopt the device id if they were host-only).
+        Returns 0 (the host byte budget is untouched)."""
+        maybe_fail("prefix.insert")
+        B = self.block_tokens
+        with self._lock:
+            if self._pool is None:
+                raise RuntimeError(
+                    "insert_device needs an attached device pool "
+                    "(attach_device_pool)"
+                )
+            self._clock += 1
+            node = self._root
+            for j in range(n_tokens // B):
+                key = tuple(ids[j * B : (j + 1) * B])
+                child = node.children.get(key)
+                if child is None:
+                    child = _Node(node, key)
+                    node.children[key] = child
+                if child.device_block is None:
+                    self._pool.retain(int(block_ids[j]))
+                    child.device_block = int(block_ids[j])
+                    self.stats["device_blocks"] += 1
+                child.last_used = self._clock
+                node = child
+        return 0
+
+    def adopt_device(self, node: _Node, block_id: int) -> None:
+        """Attach a freshly written pool block to a (host-tier) node the
+        scheduler just restored through it — the next hit on this node
+        aliases instead of paying the host copy again. No-op when the
+        node already carries a device block."""
+        with self._lock:
+            if self._pool is None or node.device_block is not None:
+                return
+            self._pool.retain(int(block_id))
+            node.device_block = int(block_id)
+            self.stats["device_blocks"] += 1
+
+    def host_blocks_for(self, node: _Node) -> "list[dict[str, np.ndarray]]":
+        """A node's KV payload in host block format: the stored host
+        blocks when present, else an EPHEMERAL device read (wire exports
+        — ``--disaggregate`` handoff, supervisor cache warming). Caller
+        must hold a pin on the node (a live ``PrefixHit``)."""
+        if node.blocks is not None:
+            return node.blocks
+        reader = self._device_reader
+        if node.device_block is None or reader is None:
+            raise ValueError("node holds neither host nor device blocks")
+        return [
+            {k: np.asarray(v) for k, v in layer.items()}
+            for layer in reader(node.device_block)
+        ]
+
+    def release_device_blocks(self, want_free: int, spill: bool = True) -> int:
+        """Release LRU unpinned device-tier blocks until the pool freed
+        ``want_free`` of them (or candidates run out). With ``spill``,
+        each block's data is read back to host first and kept under the
+        host byte budget when it fits (the wire format — nothing is lost
+        unless the host budget is also full). Returns pool blocks
+        actually freed (a block still aliased by a live slot releases the
+        tier's reference but frees nothing yet)."""
+        freed = 0
+        while freed < want_free:
+            with self._lock:
+                victim = None
+                stack = [self._root]
+                while stack:
+                    n = stack.pop()
+                    stack.extend(n.children.values())
+                    if (
+                        n.device_block is not None
+                        and n.refs == 0
+                        and (victim is None or n.last_used < victim.last_used)
+                    ):
+                        victim = n
+                if victim is None:
+                    break
+                bid = victim.device_block
+                reader = self._device_reader
+                pool = self._pool
+                need_spill = spill and victim.blocks is None
+            host = None
+            if need_spill and reader is not None:
+                try:
+                    # Device read OUTSIDE the lock (TPA105): the victim is
+                    # re-checked after reacquiring — a peer that raced us
+                    # simply wins.
+                    host = [
+                        {k: np.asarray(v) for k, v in layer.items()}
+                        for layer in reader(bid)
+                    ]
+                except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — spill is best-effort: an unreadable block is dropped (the tier must still shrink under pool pressure), and the next admission of that prefix simply full-prefills
+                    host = None
+            with self._lock:
+                if victim.device_block != bid or victim.refs:
+                    continue  # raced: re-scan
+                victim.device_block = None
+                self.stats["device_blocks"] -= 1
+                if host is not None and victim.blocks is None:
+                    nbytes = sum(
+                        a.nbytes for layer in host for a in layer.values()
+                    )
+                    if self._bytes_per_block == 0:
+                        self._bytes_per_block = nbytes
+                    if self._make_room(nbytes) is not None:
+                        victim.blocks = host
+                        victim.nbytes = nbytes
+                        victim.crc = _block_crc(host)
+                        self._bytes += nbytes
+                        self.stats["blocks"] += 1
+                        self.stats["spilled_blocks"] += 1
+                if victim.blocks is None and not victim.children:
+                    parent = victim.parent
+                    if parent is not None and (
+                        parent.children.get(victim.edge) is victim
+                    ):
+                        del parent.children[victim.edge]
+            if pool is not None and pool.release(bid):
+                freed += 1
+        return freed
 
     # ---- matching ---------------------------------------------------------
 
@@ -247,16 +427,25 @@ class PrefixCache:
             node, nodes = self._root, []
             for j in range(len(ids) // B):
                 child = node.children.get(tuple(ids[j * B : (j + 1) * B]))
-                if child is None:
+                if child is None or (
+                    # A data-less structural node (its payload was spilled
+                    # away and dropped) ends the match: positions past the
+                    # hole cannot be restored from either tier.
+                    child.blocks is None and child.device_block is None
+                ):
                     break
                 child.last_used = self._clock
                 child.refs += 1
                 nodes.append(child)
                 node = child
-        if nodes and fired("prefix.corrupt"):
-            # Chaos point: flip one byte of the first matched block's
-            # stored buffers — the checksum pass below must catch it.
-            layer = nodes[0].blocks[0]
+        corrupt_target = next(
+            (n for n in nodes if n.blocks is not None), None
+        )
+        if corrupt_target is not None and fired("prefix.corrupt"):
+            # Chaos point: flip one byte of the first matched HOST block's
+            # stored buffers — the checksum pass below must catch it
+            # (device-tier blocks have no host bytes to flip).
+            layer = corrupt_target.blocks[0]
             key = next(iter(sorted(layer)))
             arr = layer[key]
             raw = np.frombuffer(arr.tobytes(), np.uint8).copy()
@@ -266,6 +455,8 @@ class PrefixCache:
             )
         if self.verify_checksums:
             for bad in nodes:
+                if bad.blocks is None:
+                    continue  # device-resident: no host bytes to verify
                 if _block_crc(bad.blocks) == bad.crc:
                     continue
                 with self._lock:
@@ -305,6 +496,13 @@ class PrefixCache:
             if n.blocks is not None:
                 self._bytes -= n.nbytes
                 self.stats["blocks"] -= 1
+            if n.device_block is not None:
+                # cache lock -> pool lock is the ONE nesting order
+                # (never reversed anywhere), so no lock-order cycle.
+                if self._pool is not None:
+                    self._pool.release(n.device_block)
+                n.device_block = None
+                self.stats["device_blocks"] -= 1
 
     # ---- insertion + eviction --------------------------------------------
 
@@ -419,24 +617,39 @@ class PrefixCache:
             return None
         evicted = 0
         while self._bytes + nbytes > self.budget_bytes:
-            victim = None
+            victim = dev_victim = None
             stack = [self._root]
             while stack:
                 n = stack.pop()
                 stack.extend(n.children.values())
-                if (
-                    n.blocks is not None
-                    and not n.children
-                    and n.refs == 0
-                    and (victim is None or n.last_used < victim.last_used)
-                ):
-                    victim = n
+                if n.children or n.refs:
+                    continue
+                if n.blocks is not None:
+                    if victim is None or n.last_used < victim.last_used:
+                        victim = n
+                elif n.device_block is not None:
+                    # Device-only leaves free no host bytes; they are
+                    # fallback victims only when they structurally block
+                    # every host-byte chain from becoming childless.
+                    if (
+                        dev_victim is None
+                        or n.last_used < dev_victim.last_used
+                    ):
+                        dev_victim = n
+            if victim is None:
+                victim = dev_victim
             if victim is None:
                 return None
             del victim.parent.children[victim.edge]
-            self._bytes -= victim.nbytes
-            self.stats["blocks"] -= 1
-            evicted += 1
+            if victim.blocks is not None:
+                self._bytes -= victim.nbytes
+                self.stats["blocks"] -= 1
+                evicted += 1
+            if victim.device_block is not None:
+                if self._pool is not None:
+                    self._pool.release(victim.device_block)
+                victim.device_block = None
+                self.stats["device_blocks"] -= 1
         return evicted
 
     def hot_prefixes(self, limit: int = 8) -> "list[tuple[int, ...]]":
@@ -453,7 +666,9 @@ class PrefixCache:
             stack = [(self._root, ())]
             while stack:
                 node, path = stack.pop()
-                if node.blocks is not None and not node.children:
+                if not node.children and (
+                    node.blocks is not None or node.device_block is not None
+                ):
                     leaves.append((node.last_used, path))
                 for child in node.children.values():
                     stack.append((child, path + child.edge))

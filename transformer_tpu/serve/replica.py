@@ -212,14 +212,22 @@ def export_blocks(cache, ids: "list[int]") -> "tuple[int, list]":
         return 0, []
     hit = cache.match(ids[:aligned])
     try:
-        payload = [
-            [
-                {key: _encode_array(layer[key]) for key in sorted(layer)}
-                for layer in node.blocks
-            ]
-            for node in hit._nodes
-        ]
-        return hit.tokens, payload
+        payload = []
+        for node in hit._nodes:
+            try:
+                # host_blocks_for serves both tiers: stored host blocks
+                # directly, device-resident blocks via ONE ephemeral pool
+                # read (paged serving) — the wire format is identical.
+                blocks = cache.host_blocks_for(node)
+            except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — wire export is best-effort: an unreadable block truncates the payload to the readable prefix (the decode side full-prefills the rest), it must never kill the handoff
+                break
+            payload.append(
+                [
+                    {key: _encode_array(layer[key]) for key in sorted(layer)}
+                    for layer in blocks
+                ]
+            )
+        return len(payload) * B, payload
     finally:
         hit.release()
 
@@ -266,6 +274,11 @@ def _parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--speculate_k", type=int, default=0)
     p.add_argument("--prefix_cache_mb", type=int, default=0)
     p.add_argument("--prefix_block", type=int, default=16)
+    p.add_argument("--kv_layout", choices=("dense", "paged"), default="dense",
+                   help="per-slot KV storage: dense max_total buffers, or "
+                        "the paged block pool (docs/SERVING.md)")
+    p.add_argument("--kv_pool_blocks", type=int, default=0,
+                   help="paged pool size in blocks (0 = full provisioning)")
     p.add_argument("--max_backlog", type=int, default=0)
     p.add_argument("--heartbeat_ms", type=float, default=200.0)
     p.add_argument("--metrics_jsonl", default="")
@@ -376,6 +389,9 @@ def main(argv=None) -> None:
         speculate_k=args.speculate_k,
         prefix_cache=prefix_cache,
         max_backlog=args.max_backlog,
+        kv_layout=args.kv_layout,
+        kv_block=args.prefix_block,
+        kv_pool_blocks=args.kv_pool_blocks,
         span_tap=lambda span: spans_by_order.__setitem__(
             span.get("order"), span
         ),

@@ -319,6 +319,8 @@ ERROR_CODES = {
     "cancelled": "the client (or operator) cancelled the request",
     "backpressure": "the admission queue is full (max_backlog)",
     "transient": "a transient fault persisted through the bounded retries",
+    "resource": "a device resource budget (paged KV pool) was exhausted "
+                "mid-flight; the partial continuation rides along",
     "internal": "an unexpected failure; the request was isolated",
 }
 

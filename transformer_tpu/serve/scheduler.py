@@ -93,6 +93,18 @@ SLOs (``slos=`` — specs or a ``--slo_spec`` string, ``obs/slo.py``):
 every answer feeds a streaming burn-rate engine; ``serve_slo_burn_*``
 gauges and ``slo.burn`` breach-transition events ride the same telemetry,
 and ``python -m transformer_tpu.obs slo`` renders the report offline.
+
+Paged KV memory (``kv_layout="paged"``, docs/SERVING.md): every slot is
+backed by ONE device-resident block pool per layer through a per-slot
+block table (``kernels/kv_pool.py``) instead of a dense ``max_total``
+buffer — resident KV proportional to used tokens, prefix-cache hits
+restored by block-table ALIASING (zero host copies, zero forwards),
+speculative rollback a table truncation, copy-on-write guarding every
+write into a shared block. Answers are byte-identical to the dense
+layout (the paged step gathers dense-ordered views through the tables
+and runs the SAME vmapped forward); pool exhaustion degrades spill →
+``transient`` at admission → a structured ``resource`` preemption
+mid-flight, never a corrupted neighbor.
 """
 
 from __future__ import annotations
@@ -116,7 +128,11 @@ from transformer_tpu.models.transformer import (
     transformer_prefill,
     transformer_verify,
 )
-from transformer_tpu.ops.attention import insert_kv_blocks, slice_kv_blocks
+from transformer_tpu.ops.attention import (
+    insert_kv_blocks,
+    kv_buffer_keys,
+    slice_kv_blocks,
+)
 from transformer_tpu.serve.resilience import (
     BREAKER_STATE_VALUE,
     CircuitBreaker,
@@ -267,6 +283,240 @@ def _slot_read_blocks(pool_caches, slot, start, n: int):
     return [slice_kv_blocks(c, start, n) for c in slot_caches]
 
 
+# --------------------------------------------------------------------------
+# paged KV layout (--kv_layout paged): ONE block pool per layer, per-slot
+# block tables (kernels/kv_pool.py). The jitted programs below are the
+# paged twins of the dense _pool_step/_pool_verify/_slot_prefill family:
+# each gathers the slots' dense-ORDERED views through the table (sliced to
+# the dense buffer length, so every attention reduction keeps the dense
+# shape), runs the SAME vmapped model forward the dense pool runs, and
+# scatters only the newly written rows back into the pool — greedy and
+# seeded-sampled answers are bit-identical paged vs dense because the
+# compute graph consumes identical values at every unmasked position
+# (stale gathered rows sit at positions the offset causal mask already
+# hides, the invariant recycled dense slots rely on too). Per-slot cache
+# indices are HOST-authoritative in paged mode (rebuilt from st.pos each
+# call, like the pick positions), so rollback is pure table truncation.
+
+
+def _paged_views(pool_caches, table, index, buf_len: int):
+    """Per-layer stacked slot views, structurally identical to the dense
+    SlotPool pytree: leaves (N, 1, buf_len, H, D) + per-slot ``index``."""
+    from transformer_tpu.kernels.kv_pool import gather_block_views
+
+    views = []
+    for layer in pool_caches:
+        view = {
+            key: gather_block_views(layer[key], table, buf_len)[:, None]
+            for key in kv_buffer_keys(layer)
+        }
+        view["index"] = index
+        views.append(view)
+    return views
+
+
+def _paged_scatter(pool_caches, new_views, table, index, s_q: int,
+                   block_tokens: int):
+    """Write the rows the forward just produced — per slot, positions
+    ``[index, index + s_q)`` of its view — back into the pool buffers, in
+    storage layout (the view's buffers were written by the same _store_kv
+    the dense path uses, so the pool rows are bit-identical to a dense
+    cache's). Free slots (index 0, all-sink tables) land in the sink."""
+    from transformer_tpu.kernels.kv_pool import block_row_ids, scatter_rows
+
+    n = table.shape[0]
+    rids = block_row_ids(table, index, s_q, block_tokens).reshape(-1)
+    out = []
+    for layer, view in zip(pool_caches, new_views):
+        new = dict(layer)
+        for key in kv_buffer_keys(layer):
+            rows = jax.vmap(
+                lambda v, i: jax.lax.dynamic_slice_in_dim(v[0], i, s_q, axis=0)
+            )(view[key], index)  # (N, s_q, ...)
+            new[key] = scatter_rows(
+                layer[key], rids, rows.reshape(n * s_q, *rows.shape[2:])
+            )
+        out.append(new)
+    return out
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "block_tokens", "buf_len"),
+    donate_argnums=(1,),
+)
+def _pool_step_paged(
+    params, pool_caches, table, index, toks, cfg: ModelConfig,
+    block_tokens: int, buf_len: int,
+):
+    """Paged ``_pool_step``: gather views -> the SAME vmapped batch-1
+    decode step -> scatter each slot's one new row back into its block."""
+    views = _paged_views(pool_caches, table, index, buf_len)
+
+    def one(tok, caches):
+        pos = caches[0]["index"]
+        logits, caches = transformer_decode_step(
+            params, tok[None, None], None, None, caches, pos, cfg
+        )
+        return logits[0], caches
+
+    logits, new_views = jax.vmap(one)(toks, views)
+    return logits, _paged_scatter(
+        pool_caches, new_views, table, index, 1, block_tokens
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "block_tokens", "buf_len"),
+    donate_argnums=(1,),
+)
+def _pool_verify_paged(
+    params, pool_caches, table, index, toks, cfg: ModelConfig,
+    block_tokens: int, buf_len: int,
+):
+    """Paged ``_pool_verify``: W-wide rows through the same static-shape
+    verify forward; rejected tails are erased by HOST table truncation
+    (blocks return to the pool), not a device index rollback."""
+    views = _paged_views(pool_caches, table, index, buf_len)
+
+    def one(tok_row, caches):
+        pos = caches[0]["index"]
+        logits, caches = transformer_verify(
+            params, tok_row[None, :], caches, pos, cfg
+        )
+        return logits[0], caches
+
+    logits, new_views = jax.vmap(one)(toks, views)
+    return logits, _paged_scatter(
+        pool_caches, new_views, table, index, toks.shape[1], block_tokens
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "chunk", "block_tokens", "buf_len")
+)
+def _slot_prefill_paged(
+    params, pool_caches, table, slot, prompt, start, cfg: ModelConfig,
+    chunk: int, block_tokens: int, buf_len: int,
+):
+    """Paged ``_slot_prefill``: one slot's gathered view through the same
+    chunked prefill, then scatter the written suffix rows ``[start, start
+    + n)`` into the slot's blocks. ``slot`` and ``start`` stay traced (no
+    recompile per slot or hit length); NOT donated, for the same
+    admission-error isolation as the dense prefill."""
+    from transformer_tpu.kernels.kv_pool import gather_block_views, scatter_rows
+
+    row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)  # (1, nmax)
+    views = [
+        {
+            key: gather_block_views(layer[key], row, buf_len)
+            for key in kv_buffer_keys(layer)
+        }
+        for layer in pool_caches
+    ]
+    caches = [dict(v, index=jnp.asarray(start, jnp.int32)) for v in views]
+    logits, caches = transformer_prefill(
+        params, prompt, None, None, caches, start, cfg, chunk=chunk
+    )
+    n = prompt.shape[1]
+    nmax = table.shape[1]
+    pos = start + jnp.arange(n)
+    blk = jnp.take(row[0], jnp.clip(pos // block_tokens, 0, nmax - 1))
+    rids = blk * block_tokens + pos % block_tokens
+    new_pool = []
+    for layer, c in zip(pool_caches, caches):
+        new = dict(layer)
+        for key in kv_buffer_keys(layer):
+            rows = jax.lax.dynamic_slice_in_dim(c[key], start, n, axis=1)[0]
+            new[key] = scatter_rows(layer[key], rids, rows)
+        new_pool.append(new)
+    return logits, new_pool
+
+
+@jax.jit
+def _pool_write_blocks(pool_caches, bids, blocks):
+    """Write host-format prefix blocks into pool blocks ``bids`` — the
+    paged restore for HOST-tier hits (and the warm-up/disaggregation
+    inject path). ``blocks`` is per-layer dicts of (n_pad, B, H, D)
+    buffers in storage layout; ``bids`` is padded to a power-of-two count
+    with sink ids + zero rows (compile set O(log pool), never one per hit
+    length). Device-tier hits never reach here — they are pure table
+    aliasing with zero host<->device copies."""
+    out = []
+    for layer, b in zip(pool_caches, blocks):
+        new = dict(layer)
+        for key in kv_buffer_keys(layer):
+            new[key] = layer[key].at[bids].set(b[key])
+        out.append(new)
+    return out
+
+
+@jax.jit
+def _pool_read_block(pool_caches, bid):
+    """One pool block in host prefix-cache format: per-layer dicts of
+    (1, B, H, D) storage-layout buffers — byte-compatible with the dense
+    ``_slot_read_blocks`` export, so spill-to-host, ``--disaggregate``
+    KV handoff, and supervisor cache-warming keep their wire format."""
+    return [
+        {
+            key: jax.lax.dynamic_slice_in_dim(layer[key], bid, 1, axis=0)[0][
+                None
+            ]
+            for key in kv_buffer_keys(layer)
+        }
+        for layer in pool_caches
+    ]
+
+
+@jax.jit
+def _pool_copy_blocks(pool_caches, src, dst):
+    """Device-side block copies for copy-on-write splits: ``src``/``dst``
+    id vectors padded to a power of two with (sink, sink) no-op pairs."""
+    out = []
+    for layer in pool_caches:
+        new = dict(layer)
+        for key in kv_buffer_keys(layer):
+            new[key] = layer[key].at[dst].set(layer[key][src])
+        out.append(new)
+    return out
+
+
+def _pow2_pad(ids: list[int], fill: int = 0) -> list[int]:
+    """Pad an id list to the next power-of-two length (bounded compile
+    set for the block-granular device ops)."""
+    n = max(1, len(ids))
+    p = 1
+    while p < n:
+        p *= 2
+    return list(ids) + [fill] * (p - len(ids))
+
+
+def abstract_paged_pool(
+    cfg: ModelConfig, num_slots: int, max_total: int,
+    pool_blocks: int, block_tokens: int,
+):
+    """The paged pool's device layout as ShapeDtypeStructs — per-layer
+    block-pool buffers plus the (num_slots, slot_blocks) table and (N,)
+    index — the ONE statement the abstract analyses (contracts, costs)
+    share with what ``SlotPool(kv_layout="paged")`` actually allocates."""
+    from transformer_tpu.ops.attention import init_block_pool
+
+    pool = jax.eval_shape(
+        lambda: [
+            init_block_pool(
+                pool_blocks, block_tokens, cfg.kv_heads, cfg.head_dim,
+                cfg.compute_dtype, quantize=cfg.kv_cache_int8,
+            )
+            for _ in range(cfg.num_layers)
+        ]
+    )
+    slot_blocks = -(-max_total // block_tokens)
+    table = jax.ShapeDtypeStruct((num_slots, slot_blocks), np.int32)
+    index = jax.ShapeDtypeStruct((num_slots,), np.int32)
+    return pool, table, index
+
+
 @partial(jax.jit, static_argnames=("sample", "top_k", "top_p"))
 def _pick_pool(logits, base_keys, positions, temperatures, *, sample, top_k, top_p):
     """Per-slot next-token picks over the whole pool (fixed shape — one
@@ -399,13 +649,70 @@ class _Active:
 
 
 class SlotPool:
-    """A fixed pool of stacked single-request decoder KV caches."""
+    """A fixed pool of per-slot decoder KV storage: stacked dense caches
+    (``kv_layout="dense"``, the historical layout) or ONE block pool per
+    layer shared by every slot through block tables (``"paged"``,
+    kernels/kv_pool.py — resident KV proportional to used tokens)."""
 
-    def __init__(self, cfg: ModelConfig, num_slots: int, max_total: int):
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        num_slots: int,
+        max_total: int,
+        *,
+        kv_layout: str = "dense",
+        kv_block: int = 16,
+        kv_pool_blocks: int = 0,
+    ):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
+            )
         self.num_slots = num_slots
         self.max_total = max_total
+        self.layout = kv_layout
+        self.alloc = None
+        if kv_layout == "paged":
+            if cfg.attention_window:
+                # The windowed-refusal variant: a rolling buffer stores
+                # position p at slot p % buf_len and evicts on wrap, so
+                # absolute-position block rows are neither stable nor
+                # complete — same policy as the prefix cache and
+                # speculative rollback.
+                raise ValueError(
+                    "kv_layout='paged' cannot serve a rolling-window cache "
+                    "(attention_window evicts absolute-position rows on "
+                    "wrap); serve this config with kv_layout='dense'"
+                )
+            from transformer_tpu.kernels.kv_pool import KVPool
+            from transformer_tpu.ops.attention import init_block_pool
+
+            if kv_block < 1:
+                raise ValueError(f"kv_block must be >= 1, got {kv_block}")
+            self.block_tokens = kv_block
+            self.slot_blocks = -(-max_total // kv_block)
+            # Views gather at nmax*B rows then slice to max_total, so the
+            # attention reduction keeps the DENSE buffer shape (a bitwise-
+            # parity precondition).
+            self.buf_len = max_total
+            # 0 = full provisioning (every slot can always reach max_total
+            # — zero behavior change vs dense, the safe default); smaller
+            # pools bound resident KV by used tokens and lean on the spill
+            # /preemption ladder under pressure.
+            num_blocks = kv_pool_blocks or (1 + num_slots * self.slot_blocks)
+            self.alloc = KVPool(
+                num_blocks, kv_block, num_slots, self.slot_blocks
+            )
+            self.caches = [
+                init_block_pool(
+                    num_blocks, kv_block, cfg.kv_heads, cfg.head_dim,
+                    cfg.compute_dtype, quantize=cfg.kv_cache_int8,
+                )
+                for _ in range(cfg.num_layers)
+            ]
+            return
         per_slot = [
             init_decoder_caches(cfg, 1, max_total) for _ in range(num_slots)
         ]
@@ -450,6 +757,9 @@ class ContinuousScheduler:
         breaker_clock=time.monotonic,
         slos=None,
         span_tap=None,
+        kv_layout: str = "dense",
+        kv_block: int = 16,
+        kv_pool_blocks: int = 0,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -490,7 +800,25 @@ class ContinuousScheduler:
         # position — the slack keeps those writes in-bounds (a clamped
         # dynamic_update_slice would silently shift the write over REAL
         # prefix positions). Admission budgets still use max_total.
-        self.pool = SlotPool(cfg, num_slots, self.max_total + speculate_k)
+        if kv_layout == "paged" and prefix_cache is not None:
+            # Pool blocks and prefix-cache blocks must be the SAME unit:
+            # a device-tier hit aliases trie-held pool blocks straight
+            # into the slot's table.
+            kv_block = prefix_cache.block_tokens
+        self.pool = SlotPool(
+            cfg, num_slots, self.max_total + speculate_k,
+            kv_layout=kv_layout, kv_block=kv_block,
+            kv_pool_blocks=kv_pool_blocks,
+        )
+        self.paged = self.pool.layout == "paged"
+        if self.paged and prefix_cache is not None:
+            # Device-resident prefix tier: retiring slots donate their
+            # prompt blocks by aliasing (refcount, zero copies), hits
+            # alias back, and pool pressure spills LRU device blocks to
+            # the host trie in the existing wire format.
+            prefix_cache.attach_device_pool(
+                self.pool.alloc, self._read_pool_block
+            )
         self.num_slots = num_slots
         self._free = list(range(num_slots))
         self._active: dict[int, _Active] = {}
@@ -546,6 +874,13 @@ class ContinuousScheduler:
             # the prefill forwards actually dispatched — decode_bench's
             # --prefix_reuse sweep derives "forwards saved" from these.
             "prompt_tokens": 0, "prefix_hit_tokens": 0, "prefill_forwards": 0,
+            # Paged-KV accounting (kv_layout="paged"): prompt tokens whose
+            # restore was pure device-side block-table ALIASING vs tokens
+            # restored through a host block copy, slots preempted on pool
+            # exhaustion (answered "resource"), and device blocks spilled
+            # to the host trie under pool pressure.
+            "prefix_alias_tokens": 0, "host_restored_tokens": 0,
+            "kv_preempted": 0, "kv_spilled_blocks": 0,
             # Resilience accounting (telemetry-free introspection for the
             # chaos suite): transient-admission retries, deadline expiries,
             # client cancellations, backpressure refusals.
@@ -636,6 +971,23 @@ class ContinuousScheduler:
                 self._m_prefix_evicted = reg.counter(
                     "serve_prefix_evicted_blocks_total",
                     "prefix-cache KV blocks evicted under the byte budget")
+            if self.paged:
+                self._m_pool_used = reg.gauge(
+                    "serve_kv_pool_used_blocks",
+                    "paged KV pool blocks referenced by live slots or the "
+                    "device-resident prefix tier")
+                self._m_pool_free = reg.gauge(
+                    "serve_kv_pool_free_blocks",
+                    "paged KV pool blocks on the free list")
+                self._m_pool_used.set(self.pool.alloc.used_blocks)
+                self._m_pool_free.set(self.pool.alloc.free_blocks)
+                if prefix_cache is not None:
+                    self._m_alias_tokens = reg.counter(
+                        "serve_prefix_alias_tokens_total",
+                        "prompt tokens served by device-side block-table "
+                        "aliasing (zero host<->device copies) — a subset "
+                        "of serve_prefix_hit_tokens_total; the remainder "
+                        "was restored through a host block copy")
             self._m_deadline = reg.counter(
                 "serve_deadline_expired_total",
                 "requests answered with a deadline error")
@@ -716,6 +1068,130 @@ class ContinuousScheduler:
             st.span_decode = self._tracer.start_span(
                 "serve.decode", parent=st.span_root, lane=st.span_root.lane
             )
+
+    # ---- paged-KV plumbing (kv_layout="paged") ----------------------------
+
+    def _read_pool_block(self, bid: int):
+        """Fetch ONE pool block to host prefix-cache format — the only
+        host<->device block copy the paged prefix tier ever pays, and only
+        for spill-under-pressure or a wire export (--disaggregate handoff,
+        supervisor cache warming). The device-resident HIT path never
+        reaches here (pinned by test)."""
+        return jax.device_get(
+            _pool_read_block(self.pool.caches, jnp.int32(bid))
+        )
+
+    def _paged_alloc(self, fn):
+        """Run an allocator mutation with ONE spill-and-retry rung: on
+        pool exhaustion, ask the prefix cache's device tier to release
+        LRU blocks (their data spills to the host trie in the wire format
+        first), then retry. Re-raises ``KVPoolExhausted`` when the pool
+        is genuinely full of live slots — admission maps that to a
+        retryable transient, the step path to a preemption."""
+        from transformer_tpu.kernels.kv_pool import KVPoolExhausted
+
+        try:
+            return fn()
+        except KVPoolExhausted:
+            if self.prefix_cache is None:
+                raise
+            freed = self.prefix_cache.release_device_blocks(
+                max(1, self.pool.slot_blocks)
+            )
+            self.stats["kv_spilled_blocks"] += freed
+            if not freed:
+                raise
+            return fn()
+
+    def _paged_ensure(self, slot: int, tokens: int) -> None:
+        """Grow ``slot``'s block table to cover ``tokens`` positions."""
+        self._paged_alloc(lambda: self.pool.alloc.ensure(slot, tokens))
+
+    def _paged_cow(self, slot: int, start: int, end: int) -> None:
+        """Copy-on-write guard before writing positions ``[start, end)``:
+        any table block shared with the device tier (or another slot) is
+        split — fresh block allocated, contents copied ON DEVICE, table
+        updated — before the write dispatches. Serving flows only write
+        past the block-aligned aliased prefix, so this is normally a
+        no-op; it is the guard that makes aliasing safe by construction."""
+        pairs = self._paged_alloc(
+            lambda: self.pool.alloc.make_writable(slot, start, end)
+        )
+        if pairs:
+            src = jnp.asarray(_pow2_pad([s for s, _ in pairs]), jnp.int32)
+            dst = jnp.asarray(_pow2_pad([d for _, d in pairs]), jnp.int32)
+            self.pool.caches = _pool_copy_blocks(self.pool.caches, src, dst)
+
+    def _paged_restore(self, slot: int, hit, m: int) -> int:
+        """Paged restore of a matched ``m``-token prefix: device-tier
+        nodes ALIAS their pool block into the slot's table (zero model
+        forwards, zero host<->device copies); host-tier nodes take a
+        fresh block and ride ONE batched scatter write (then the device
+        tier adopts the written block, so the NEXT hit aliases). Returns
+        the aliased token count."""
+        B = self.pool.block_tokens
+        alloc = self.pool.alloc
+        aliased = 0
+        host_bids: list[int] = []
+        host_payload: list = []  # per restored block: per-layer dicts
+        adopt: list = []
+        for node, bid, blocks in hit.paged_plan():
+            if bid is not None:
+                self._paged_alloc(lambda b=bid: alloc.extend(slot, bid=b))
+                aliased += B
+            else:
+                _, new_bid = self._paged_alloc(lambda: alloc.extend(slot))
+                host_bids.append(new_bid)
+                host_payload.append(blocks)
+                adopt.append((node, new_bid))
+        if host_bids:
+            bids = _pow2_pad(host_bids)
+            pad = len(bids) - len(host_bids)
+            stacked = [
+                {
+                    key: np.concatenate(
+                        [np.asarray(blk[li][key]) for blk in host_payload]
+                        + [np.zeros_like(host_payload[0][li][key])] * pad,
+                        axis=0,
+                    )
+                    for key in host_payload[0][li]
+                }
+                for li in range(len(host_payload[0]))
+            ]
+            self.pool.caches = _pool_write_blocks(
+                self.pool.caches, jnp.asarray(bids, jnp.int32), stacked
+            )
+            for node, bid in adopt:
+                self.prefix_cache.adopt_device(node, bid)
+        # Stats are recorded by the caller at admission SUCCESS (next to
+        # prefix_hit_tokens): counting here would double-count retried
+        # admissions and break the alias <= hit invariant.
+        return aliased
+
+    def _paged_prepare(self, width: int) -> None:
+        """Before a paged step: every occupied slot needs blocks covering
+        its write range ``[pos, pos + width)``, CoW-split where shared.
+        Pool exhaustion (after the spill ladder) preempts the REQUESTING
+        slot with a structured ``resource`` answer carrying its partial
+        continuation — bounded degradation, never a corrupted neighbor."""
+        from transformer_tpu.kernels.kv_pool import KVPoolExhausted
+
+        for slot, st in list(self._active.items()):
+            try:
+                self._paged_ensure(slot, st.pos + width)
+                self._paged_cow(slot, st.pos, st.pos + width)
+            except KVPoolExhausted as e:
+                self.stats["kv_preempted"] += 1
+                self._abort(
+                    slot, st, "resource",
+                    f"kv pool exhausted after {len(st.emitted)} of "
+                    f"{st.max_new} tokens: {e}",
+                )
+
+    def _paged_gauges(self) -> None:
+        if self.paged and self._tel is not None:
+            self._m_pool_used.set(self.pool.alloc.used_blocks)
+            self._m_pool_free.set(self.pool.alloc.free_blocks)
 
     def submit(self, req: dict) -> int:
         now = time.perf_counter()
@@ -1102,6 +1578,8 @@ class ContinuousScheduler:
         its hit synchronously before the request ever reaches a step
         boundary."""
         del self._active[slot]
+        if self.paged:
+            self.pool.alloc.free_slot(slot)
         self._free.append(slot)
         resp = error_answer(code, message)
         if st.emitted:
@@ -1111,7 +1589,7 @@ class ContinuousScheduler:
         self._done[st.order] = resp
         if code == "deadline":
             self.stats["deadline_expired"] += 1
-        else:
+        elif code == "cancelled":
             self.stats["cancelled"] += 1
         root = st.span_root
         self._end_spans(st, ("span_prefill", "span_decode"))
@@ -1121,8 +1599,10 @@ class ContinuousScheduler:
         )
         if self._tel is not None:
             now = time.perf_counter()
-            (self._m_deadline if code == "deadline"
-             else self._m_cancelled).inc()
+            if code == "deadline":
+                self._m_deadline.inc()
+            elif code == "cancelled":
+                self._m_cancelled.inc()
             self._m_errors.inc()
             self._record_request(
                 {
@@ -1255,6 +1735,7 @@ class ContinuousScheduler:
             p.span_prefill = self._tracer.start_span(
                 "serve.prefill", parent=p.span_root, lane=f"slot{slot}",
             )
+        aliased = 0
         try:
             if m:
                 try:
@@ -1262,23 +1743,58 @@ class ContinuousScheduler:
                         "prefix.restore", p.span_prefill,
                         lane=f"slot{slot}", tokens=m,
                     ):
-                        self.pool.caches = _slot_restore(
-                            self.pool.caches, jnp.int32(slot),
-                            hit.stacked(self.max_total + self.speculate_k),
-                        )
-                except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — same degradation contract as the match above: a failed restore falls back to full prefill (the slot's index reset makes any partial restore invisible), feeding the breaker instead of erroring the request
+                        if self.paged:
+                            aliased = self._paged_restore(slot, hit, m)
+                        else:
+                            self.pool.caches = _slot_restore(
+                                self.pool.caches, jnp.int32(slot),
+                                hit.stacked(self.max_total + self.speculate_k),
+                            )
+                except TransientError:
+                    # Pool pressure (KVPoolExhausted maps below), retried
+                    # faults: not the cache's fault — no breaker feed.
+                    raise
+                except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — same degradation contract as the match above: a failed restore falls back to full prefill (the slot's index reset makes any partial restore invisible), feeding the breaker instead of erroring the request
+                    from transformer_tpu.kernels.kv_pool import KVPoolExhausted
+
+                    if isinstance(e, KVPoolExhausted):
+                        # Exhaustion mid-restore is pool pressure, not a
+                        # cache fault: surface as a retryable transient.
+                        raise TransientError(str(e)) from e
                     self._brk_prefix.record_failure()
                     prefix_ok = False
                     hit.release()
-                    hit, m = None, 0
+                    hit, m, aliased = None, 0, 0
+                    if self.paged:
+                        # Drop any partially-aliased table entries so the
+                        # fallback full prefill starts from a clean row.
+                        self.pool.alloc.free_slot(slot)
                     n_suffix = prefill_len_for(L, self.prefill_chunk)
                     n = n_suffix
-            logits, self.pool.caches = _slot_prefill(
-                self.params, self.pool.caches, jnp.int32(slot),
-                jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m), self.cfg,
-                self.prefill_chunk,
-            )
+            if self.paged:
+                from transformer_tpu.kernels.kv_pool import KVPoolExhausted
+
+                try:
+                    self._paged_ensure(slot, n)
+                    self._paged_cow(slot, m, n)
+                except KVPoolExhausted as e:
+                    raise TransientError(str(e)) from e
+                logits, self.pool.caches = _slot_prefill_paged(
+                    self.params, self.pool.caches,
+                    self.pool.alloc.table_device(), jnp.int32(slot),
+                    jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m),
+                    self.cfg, self.prefill_chunk,
+                    self.pool.block_tokens, self.pool.buf_len,
+                )
+            else:
+                logits, self.pool.caches = _slot_prefill(
+                    self.params, self.pool.caches, jnp.int32(slot),
+                    jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m), self.cfg,
+                    self.prefill_chunk,
+                )
         except Exception:
+            if self.paged:
+                self.pool.alloc.free_slot(slot)
             self._free.append(slot)
             raise
         finally:
@@ -1290,12 +1806,19 @@ class ContinuousScheduler:
             self._brk_prefix.record_success()
         self.stats["prompt_tokens"] += L
         self.stats["prefix_hit_tokens"] += m
+        if self.paged:
+            # Restored tokens split: m = aliased (device table op, zero
+            # copies) + host-restored (one batched block write).
+            self.stats["prefix_alias_tokens"] += aliased
+            self.stats["host_restored_tokens"] += m - aliased
         chunk = self.prefill_chunk
         self.stats["prefill_forwards"] += (
             -(-n_suffix // chunk) if chunk > 0 else 1
         )
         if m and self._tel is not None and self.prefix_cache is not None:
             self._m_prefix_hit.inc(m)
+            if aliased:
+                self._m_alias_tokens.inc(aliased)
         spec = bool(self.speculate_k) and bool(req.get("speculate", True))
         st = _Active(
             order=order, ids=ids, prompt_len=L, pos=n, cur=PAD_ID,
@@ -1352,6 +1875,8 @@ class ContinuousScheduler:
                 # spans travel back to the _Pending so the answer path can
                 # close them).
                 del self._active[slot]
+                if self.paged:
+                    self.pool.alloc.free_slot(slot)
                 self._free.append(slot)
                 p.span_root, p.span_prefill = st.span_root, st.span_prefill
                 raise
@@ -1372,11 +1897,17 @@ class ContinuousScheduler:
         speculative verify path. Retires finished slots; no-op when the
         pool is idle."""
         self._expire(time.perf_counter())
+        if self._active and self.paged:
+            # Paged capacity pass BEFORE the step arrays are built: a
+            # pool-exhausted slot is preempted here (answered "resource")
+            # and must not be stepped.
+            self._paged_prepare(self.speculate_k + 1 if self.speculate_k else 1)
         if not self._active:
             if self._tel is not None:
                 self._m_active.set(0)
                 self._m_backlog.set(len(self._queue))
                 self._m_ready.set(len(self._done))
+                self._paged_gauges()
                 self._tel.maybe_flush()
                 if self._slo is not None:
                     self._slo.maybe_evaluate()
@@ -1404,9 +1935,17 @@ class ContinuousScheduler:
             keys[slot] = st.key
             positions[slot] = st.pos
             temps[slot] = st.temperature
-        logits, self.pool.caches = _pool_step(
-            self.params, self.pool.caches, jnp.asarray(toks), self.cfg
-        )
+        if self.paged:
+            logits, self.pool.caches = _pool_step_paged(
+                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/else twin of the dense donating call below: exactly one branch runs per step and both rebind self.pool.caches from their own result
+                self.pool.alloc.table_device(), jnp.asarray(positions),
+                jnp.asarray(toks), self.cfg,
+                self.pool.block_tokens, self.pool.buf_len,
+            )
+        else:
+            logits, self.pool.caches = _pool_step(
+                self.params, self.pool.caches, jnp.asarray(toks), self.cfg
+            )
         groups: dict[tuple, list[int]] = {}
         for slot, st in self._active.items():
             groups.setdefault((st.sample, st.top_k, st.top_p), []).append(slot)
@@ -1448,6 +1987,7 @@ class ContinuousScheduler:
             self._m_active.set(len(self._active))
             self._m_backlog.set(len(self._queue))
             self._m_ready.set(len(self._done))
+            self._paged_gauges()
             self._tel.maybe_flush()
             if self._slo is not None:
                 self._slo.maybe_evaluate()
@@ -1523,9 +2063,17 @@ class ContinuousScheduler:
             verify_span = self._tracer.start_span(
                 "spec.verify", parent=step_span, lane="scheduler", width=W,
             )
-        logits, self.pool.caches = _pool_verify(
-            self.params, self.pool.caches, jnp.asarray(toks), self.cfg
-        )
+        if self.paged:
+            logits, self.pool.caches = _pool_verify_paged(
+                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/else twin of the dense donating call below: exactly one branch runs per step and both rebind self.pool.caches from their own result
+                self.pool.alloc.table_device(), jnp.asarray(positions),
+                jnp.asarray(toks), self.cfg,
+                self.pool.block_tokens, self.pool.buf_len,
+            )
+        else:
+            logits, self.pool.caches = _pool_verify(
+                self.params, self.pool.caches, jnp.asarray(toks), self.cfg
+            )
         groups: dict[tuple, list[int]] = {}
         for slot, st in self._active.items():
             groups.setdefault((st.sample, st.top_k, st.top_p), []).append(slot)
@@ -1607,9 +2155,19 @@ class ContinuousScheduler:
             rollback_span = self._tracer.start_span(
                 "spec.rollback", parent=step_span, lane="scheduler"
             )
-        self.pool.caches = _pool_rollback(
-            self.pool.caches, jnp.asarray(delta)
-        )
+        if self.paged:
+            # Paged rollback IS table truncation: blocks past each slot's
+            # kept width return to the pool's free list (re-ensured next
+            # step), stale rows inside the kept block stay masked, and no
+            # device index needs resetting — per-slot indices are rebuilt
+            # from host state every call. Retired slots already freed
+            # their whole row in _finish.
+            for slot, st in self._active.items():
+                self.pool.alloc.truncate(slot, st.pos)
+        else:
+            self.pool.caches = _pool_rollback(
+                self.pool.caches, jnp.asarray(delta)  # tpa: disable=TPA005 — the linter's linear scan pairs this dense-branch donation with the paged verify call above; the branches are mutually exclusive and every donating call rebinds immediately
+            )
         if rollback_span is not None:
             rollback_span.end()
         self.stats["steps"] += 1
@@ -1629,6 +2187,7 @@ class ContinuousScheduler:
             self._m_active.set(len(self._active))
             self._m_backlog.set(len(self._queue))
             self._m_ready.set(len(self._done))
+            self._paged_gauges()
             self._tel.maybe_flush()
             if self._slo is not None:
                 self._slo.maybe_evaluate()
@@ -1693,15 +2252,31 @@ class ContinuousScheduler:
                         lane=st.span_root.lane if st.span_root else None,
                         tokens=aligned,
                     ):
-                        evicted = self.prefix_cache.insert(
-                            st.ids, aligned,
-                            lambda start: jax.device_get(
-                                _slot_read_blocks(
-                                    self.pool.caches, jnp.int32(slot),
-                                    jnp.int32(start), B,
-                                )
-                            ),
-                        )
+                        if self.paged:
+                            # Device-tier donation: the trie ADOPTS the
+                            # retiring slot's prompt blocks by reference
+                            # (pool refcount) — zero device reads, zero
+                            # host copies; spill-to-host happens lazily
+                            # under pool pressure or a wire export.
+                            evicted = self.prefix_cache.insert_device(
+                                st.ids, aligned,
+                                [
+                                    int(b)
+                                    for b in self.pool.alloc.table[slot][
+                                        : aligned // B
+                                    ]
+                                ],
+                            )
+                        else:
+                            evicted = self.prefix_cache.insert(
+                                st.ids, aligned,
+                                lambda start: jax.device_get(
+                                    _slot_read_blocks(
+                                        self.pool.caches, jnp.int32(slot),
+                                        jnp.int32(start), B,
+                                    )
+                                ),
+                            )
                 except Exception:  # noqa: BLE001  # tpa: disable=TPA006 — feeding the trie is best-effort: a retirement-side cache fault (injected or real) feeds the breaker and this request simply does not donate its KV; its ANSWER is already computed and must still flush
                     self._brk_prefix.record_failure()
                 else:
@@ -1720,6 +2295,11 @@ class ContinuousScheduler:
         )[0]
         self._done[st.order] = {"continuation": text}
         del self._active[slot]
+        if self.paged:
+            # After donation: table references drop, aliased prompt blocks
+            # live on under the device tier's refs, everything else
+            # returns to the free list.
+            self.pool.alloc.free_slot(slot)
         self._free.append(slot)
         root = st.span_root
         self._end_spans(st, ("span_prefill",))
