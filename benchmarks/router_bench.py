@@ -26,6 +26,12 @@ acceptance numbers:
   rows the death-to-readmission seconds plus how many requests the
   surviving fleet answered during the gap (the self-healing tier's
   acceptance numbers, docs/SERVING.md "Self-healing fleet").
+- **time-to-upgrade** — with ``--upgrade`` (default) another soak rolls a
+  manifest-verified checkpoint swap across the fleet MID-RUN (quiesce ->
+  double-buffered swap -> canary window -> promote): the row records the
+  rollout wall time, requests served during it, the canary's request
+  share, and zero lost/errored requests (the live-weights control
+  plane's acceptance numbers, docs/SERVING.md "Live-weights rollout").
 """
 
 from __future__ import annotations
@@ -234,6 +240,129 @@ def run_heal(args, spec_path: str) -> dict:
     }
 
 
+def run_upgrade(args, spec_path: str) -> dict:
+    """The live-weights soak: roll a verified checkpoint swap across a
+    2-replica fleet mid-workload; every request answers, tagged by the
+    weight_version that served it, with zero recompiles replica-side."""
+    import tempfile as _tempfile
+
+    from transformer_tpu.serve.replica import build_model_from_spec
+    from transformer_tpu.serve.router import ReplicaProcess, Router
+    from transformer_tpu.serve.supervisor import Supervisor
+    from transformer_tpu.serve.upgrade import UpgradeCoordinator
+    from transformer_tpu.train.checkpoint import CheckpointManager
+
+    old_params, _, tok = build_model_from_spec(SPEC)
+    # The upgrade artifact: the SAME architecture initialized from a
+    # different seed, saved with the checksummed manifest — byte-different
+    # weights, structurally a twin (the zero-recompile precondition). The
+    # fleet also BOOTSTRAPS from a manifest-verified checkpoint of the
+    # old weights, so every answer is version-tagged end to end.
+    new_params, _, _ = build_model_from_spec({**SPEC, "seed": 1})
+    old_root = _tempfile.mkdtemp(prefix="upgrade_old_")
+    old_dir = CheckpointManager(old_root, is_primary=True).save(
+        old_params, step=1
+    )
+    ckpt_root = _tempfile.mkdtemp(prefix="upgrade_ckpt_")
+    ckpt_dir = CheckpointManager(ckpt_root, is_primary=True).save(
+        new_params, step=1
+    )
+
+    worker = [
+        "--model_spec", spec_path,
+        "--init_ckpt", old_dir,
+        "--serve_slots", str(args.slots),
+        "--prefix_cache_mb", "32",
+        "--prefix_block", str(args.prefix_block),
+        "--kv_layout", getattr(args, "kv_layout", "dense"),
+        "--heartbeat_ms", "100",
+    ]
+    n_replicas = 2
+    links = [ReplicaProcess.spawn(i, list(worker)) for i in range(n_replicas)]
+
+    def spawn(index, name, role, weight_target=None):
+        argv = list(worker)
+        if weight_target is not None:
+            argv += ["--init_ckpt", weight_target[0],
+                     "--weight_version", weight_target[1]]
+        return ReplicaProcess.spawn(index, argv, role=role, name=name)
+
+    sup = Supervisor(spawn, backoff_ms=50.0)
+    up = UpgradeCoordinator(canary_window_s=0.5, canary_min_requests=1)
+    router = Router(
+        links, encode=tok.encode, bos_id=tok.bos_id,
+        affinity_block=args.prefix_block, heartbeat_timeout_s=10.0,
+        supervisor=sup, upgrader=up,
+    )
+    for link in links:
+        link.start_reader(router.inbox)
+
+    reqs = _workload(args.requests, n_replicas, args.system_words)
+    t0 = time.perf_counter()
+    # LIVE traffic, not a pre-loaded batch: keep a bounded window of
+    # requests outstanding so the rollout quiesces replicas against a
+    # stream (and the canary window has traffic to judge), the shape a
+    # production swap actually runs under.
+    window = max(2, args.slots)
+    next_req = 0
+    answered = []
+    started = False
+    t_up0 = t_up1 = None
+    rollout_served = 0
+    deadline = time.time() + 300
+    while (
+        len(answered) < len(reqs) or (started and up.active)
+    ) and time.time() < deadline:
+        while next_req < len(reqs) and router.backlog < window:
+            router.submit(dict(reqs[next_req]))
+            next_req += 1
+        router.pump()
+        fresh = router.drain_ready()
+        answered.extend(fresh)
+        if started and up.active:
+            rollout_served += len(fresh)
+        if not started and len(answered) >= args.requests // 4:
+            status = router.start_upgrade(ckpt_root)
+            assert status.get("ok"), f"upgrade refused: {status}"
+            started = True
+            t_up0 = time.perf_counter()
+        if started and t_up1 is None and not up.active:
+            t_up1 = time.perf_counter()
+    answered.extend(router.drain_ready())
+    wall = time.perf_counter() - t0
+    if started and t_up1 is None and not up.active:
+        t_up1 = time.perf_counter()
+    router.shutdown()
+    versions: dict = {}
+    for a in answered:
+        v = a.get("weight_version")
+        if v is not None:
+            versions[v] = versions.get(v, 0) + 1
+    return {
+        "mode": "upgrade",
+        "replicas": n_replicas,
+        "requests": len(reqs),
+        "answered": len(answered),
+        "answered_ok": sum(1 for a in answered if "continuation" in a),
+        "wall_s": round(wall, 3),
+        "upgrade_state": up.state,
+        "version": up.target_version,
+        "time_to_upgrade_s": (
+            None if t_up0 is None or t_up1 is None
+            else round(t_up1 - t_up0, 3)
+        ),
+        "served_during_rollout": rollout_served,
+        "canary_requests": up.stats["canary_requests"],
+        "canary_share": (
+            round(up.stats["canary_requests"] / rollout_served, 4)
+            if rollout_served else None
+        ),
+        "rollbacks": up.stats["rollbacks"],
+        "per_version_answers": versions,
+        "ckpt": ckpt_dir,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replica_counts", type=str, default="1,2,4")
@@ -255,6 +384,12 @@ def main() -> None:
                    help="run the supervised-respawn soak: SIGKILL one of "
                         "2 supervised replicas mid-run and row the "
                         "time-to-heal + requests served during the gap")
+    p.add_argument("--upgrade", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="run the live-weights soak: roll a verified "
+                        "checkpoint swap across 2 replicas mid-run and "
+                        "row time-to-upgrade, requests served during the "
+                        "rollout, and the canary share")
     p.add_argument("--rows_out", type=str, default="",
                    help="append bench_rows.jsonl-compatible rows here "
                         "('' = print them to stderr)")
@@ -329,6 +464,35 @@ def main() -> None:
                 "served_during_gap": result["served_during_gap"],
                 "warmed_tokens": result["warmed_tokens"],
                 "redispatch_count": result["redispatch_count"],
+                "device": device,
+                "vs_baseline": None,
+            }))
+        if args.upgrade:
+            result = run_upgrade(args, spec_path)
+            print(json.dumps(result))
+            assert result["answered"] == result["requests"], (
+                "upgrade soak lost requests"
+            )
+            assert result["answered_ok"] == result["requests"], (
+                f"upgrade soak had errored requests: {result}"
+            )
+            assert result["upgrade_state"] == "done", (
+                f"rollout did not complete: {result}"
+            )
+            rows.append(json.dumps({
+                "metric": "router time-to-upgrade",
+                "value": result["time_to_upgrade_s"],
+                "unit": "s",
+                "config": {
+                    "replicas": result["replicas"], "slots": args.slots,
+                    "requests": args.requests,
+                    "system_words": args.system_words,
+                    "prefix_block": args.prefix_block,
+                },
+                "served_during_rollout": result["served_during_rollout"],
+                "canary_share": result["canary_share"],
+                "rollbacks": result["rollbacks"],
+                "per_version_answers": result["per_version_answers"],
                 "device": device,
                 "vs_baseline": None,
             }))

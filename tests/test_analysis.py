@@ -784,6 +784,7 @@ def test_schedules_canned_scenarios_clean():
         "prefix_cache_contention", "kv_pool_contention",
         "registry_scrape_vs_create", "prefetch_shutdown",
         "eventlog_writers", "router_dispatch_tables", "supervisor_respawn",
+        "rolling_upgrade",
     }
 
 

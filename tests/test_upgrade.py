@@ -1,0 +1,845 @@
+"""Live-weights control plane (``serve/upgrade.py``, docs/SERVING.md
+"Live-weights rollout"): verified-integrity checkpoint manifests, the
+scheduler's two-version param slot (admission-time weights, zero
+recompiles), the router-coordinated rolling swap with canary gating and
+SLO-driven auto-rollback, and the supervisor's respawn-at-target fix."""
+
+import io
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from transformer_tpu.obs import EventLog, Telemetry
+from transformer_tpu.serve.router import ReplicaLink, ReplicaProcess, Router
+from transformer_tpu.serve.supervisor import Supervisor
+from transformer_tpu.serve.upgrade import (
+    UpgradeCoordinator,
+    UpgradeError,
+    load_checkpoint_params,
+    verify_checkpoint,
+)
+
+# The deterministic test-model bootstrap (tests/test_router.py): every
+# process building this spec gets bit-identical params and vocab, so
+# byte-parity assertions hold across process boundaries AND versions.
+SPEC = {
+    "config": {
+        "num_layers": 1, "d_model": 16, "num_heads": 2, "dff": 32,
+        "max_position": 32, "decoder_only": True, "tie_output": True,
+        "dtype": "float32", "dropout_rate": 0.0,
+    },
+    "seed": 0,
+    "corpus": ["ab cd ef gh ij kl mn"] * 3,
+    "target_vocab_size": 300,
+}
+PROMPT = "ab cd ef gh ij"
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from transformer_tpu.serve.replica import build_model_from_spec
+
+    return build_model_from_spec(SPEC)
+
+
+@pytest.fixture(scope="module")
+def lm_new():
+    """The upgrade target: the SAME architecture from a different init
+    seed — structurally a twin (the zero-recompile precondition), byte-
+    different weights (so version tags are testable, not decorative)."""
+    from transformer_tpu.serve.replica import build_model_from_spec
+
+    return build_model_from_spec({**SPEC, "seed": 1})
+
+
+@pytest.fixture(scope="module")
+def spec_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("upgrade") / "spec.json"
+    path.write_text(json.dumps(SPEC))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def ckpts(tmp_path_factory, lm, lm_new):
+    """(old_dir, new_dir): manifest-bearing param checkpoints of both
+    versions, saved through the real CheckpointManager."""
+    from transformer_tpu.train.checkpoint import CheckpointManager
+
+    root = tmp_path_factory.mktemp("ckpts")
+    old_dir = CheckpointManager(str(root / "old"), is_primary=True).save(
+        lm[0], step=1
+    )
+    new_dir = CheckpointManager(str(root / "new"), is_primary=True).save(
+        lm_new[0], step=1
+    )
+    return old_dir, new_dir
+
+
+def _reference(model, reqs):
+    from transformer_tpu.serve import ContinuousScheduler
+
+    params, cfg, tok = model
+    return ContinuousScheduler(params, cfg, tok, num_slots=2).run(
+        [dict(r) for r in reqs]
+    )
+
+
+def _events(buf: io.StringIO) -> list:
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+# --------------------------------------------------------------------------
+# checkpoint manifest: checksummed, atomic, preferred by restore_latest
+
+
+def test_manifest_digest_names_bytes(tmp_path):
+    from transformer_tpu.train.checkpoint import (
+        CheckpointManager,
+        checkpoint_version,
+        verify_manifest,
+    )
+
+    mgr = CheckpointManager(str(tmp_path), is_primary=True)
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    p1 = mgr.save(state, step=1)
+    assert sorted(f for f in os.listdir(p1)) == [
+        "arrays.npz", "manifest.json", "meta.json",
+    ]
+    v1 = verify_manifest(p1)
+    assert checkpoint_version(p1) == v1
+    # Byte-identical save -> identical digest (the weight_version
+    # contract); different bytes -> different digest.
+    p2 = mgr.save(state, step=2)
+    assert checkpoint_version(p2) == v1
+    p3 = mgr.save({"w": state["w"] + 1}, step=3)
+    assert checkpoint_version(p3) != v1
+
+
+def test_manifest_catches_what_the_structural_probe_cannot(tmp_path, capsys):
+    """A checkpoint whose arrays were swapped for DIFFERENT same-shaped
+    values unpickles fine and passes every shape check — only the crc32
+    manifest knows the bytes are wrong. restore_latest must fall back."""
+    from transformer_tpu.train.checkpoint import (
+        CheckpointIntegrityError,
+        CheckpointManager,
+        verify_manifest,
+    )
+
+    mgr = CheckpointManager(str(tmp_path), is_primary=True)
+    good = {"w": np.full((2, 3), 7.0, np.float32)}
+    mgr.save(good, step=1)
+    p2 = mgr.save({"w": np.full((2, 3), 9.0, np.float32)}, step=2)
+    # Swap step 2's arrays for same-shaped different bytes (a mixed copy /
+    # silent corruption): the zip is valid, the shapes match the target.
+    donor = CheckpointManager(str(tmp_path / "donor"), is_primary=True)
+    dpath = donor.save({"w": np.full((2, 3), 5.0, np.float32)}, step=9)
+    os.replace(
+        os.path.join(dpath, "arrays.npz"), os.path.join(p2, "arrays.npz")
+    )
+    with pytest.raises(CheckpointIntegrityError):
+        verify_manifest(p2)
+    restored = mgr.restore_latest({"w": np.zeros((2, 3), np.float32)})
+    np.testing.assert_array_equal(restored["w"], good["w"])
+    assert "falling back" in capsys.readouterr().err
+
+
+def test_torn_manifest_falls_back(tmp_path):
+    from transformer_tpu.train.checkpoint import (
+        CheckpointIntegrityError,
+        CheckpointManager,
+        load_manifest,
+    )
+
+    mgr = CheckpointManager(str(tmp_path), is_primary=True)
+    mgr.save({"w": np.full((2,), 1.0, np.float32)}, step=1)
+    p2 = mgr.save({"w": np.full((2,), 2.0, np.float32)}, step=2)
+    # A half-written manifest (the crash shape the atomic tmp+fsync+rename
+    # write prevents for OUR writes, but partial copies still produce).
+    with open(os.path.join(p2, "manifest.json"), "w") as f:
+        f.write('{"format": "manifest-v1", "arrays": {"w"')
+    with pytest.raises(CheckpointIntegrityError):
+        load_manifest(p2)
+    fallbacks = []
+    restored = mgr.restore_latest(
+        {"w": np.zeros((2,), np.float32)},
+        on_fallback=lambda step, exc: fallbacks.append(step),
+    )
+    np.testing.assert_array_equal(restored["w"], np.full((2,), 1.0))
+    assert fallbacks == [2]
+
+
+# --------------------------------------------------------------------------
+# replica-side verified load + the scheduler's two-version param slot
+
+
+def test_load_checkpoint_params_verifies_and_matches(lm, lm_new, ckpts):
+    params, cfg, tok = lm
+    _, new_dir = ckpts
+    loaded, version = load_checkpoint_params(new_dir, params)
+    assert version == verify_checkpoint(new_dir)[1]
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(loaded),
+        jax.tree_util.tree_leaves(lm_new[0]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_checkpoint_params_refuses_wrong_spec(tmp_path, lm):
+    """A checkpoint of a DIFFERENT architecture must be refused before
+    anything is staged — shape/dtype twins are the zero-recompile
+    precondition."""
+    from transformer_tpu.serve.replica import build_model_from_spec
+    from transformer_tpu.train.checkpoint import CheckpointManager
+
+    other_params, _, _ = build_model_from_spec(
+        {**SPEC, "config": {**SPEC["config"], "d_model": 32, "dff": 64}}
+    )
+    path = CheckpointManager(str(tmp_path), is_primary=True).save(
+        other_params, step=1
+    )
+    with pytest.raises(UpgradeError, match="does not match the running"):
+        load_checkpoint_params(path, lm[0])
+
+
+def test_verify_checkpoint_refuses_unmanifested(tmp_path):
+    """A checkpoint without a manifest cannot prove byte-consistency
+    across N replicas — the control plane refuses it."""
+    from transformer_tpu.train.checkpoint import CheckpointManager
+
+    path = CheckpointManager(str(tmp_path), is_primary=True).save(
+        {"w": np.zeros((2,), np.float32)}, step=1
+    )
+    os.unlink(os.path.join(path, "manifest.json"))
+    with pytest.raises(UpgradeError, match="no manifest"):
+        verify_checkpoint(path)
+
+
+def test_scheduler_swap_admission_time_weights_zero_recompiles(lm, lm_new):
+    """The two-version param slot end to end: requests admitted before
+    the stage finish on THEIR weights while admission quiesces, the flip
+    lands at the drained step boundary, rollback re-stages the resident
+    old pair — all with zero new compiled programs."""
+    from transformer_tpu.analysis.retrace import _cache_size
+    from transformer_tpu.serve import ContinuousScheduler
+    from transformer_tpu.serve import scheduler as smod
+
+    params, cfg, tok = lm
+    reqs = [{"prompt": PROMPT, "max_new": 6}] * 2
+    want_old = _reference(lm, reqs)
+    want_new = _reference(lm_new, reqs)
+    assert want_old[0]["continuation"] != want_new[0]["continuation"], (
+        "old and new weights answer identically — the tag test is vacuous"
+    )
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, weight_version="vOLD"
+    )
+    s.run([dict(r) for r in reqs])  # warmup compiles
+    before = {
+        "step": _cache_size(smod._pool_step),
+        "prefill": _cache_size(smod._slot_prefill),
+        "pick": _cache_size(smod._pick_pool),
+    }
+    # Straddle: admit on vOLD, stage vNEW mid-flight.
+    for r in reqs:
+        s.submit(dict(r))
+    s.admit()
+    assert s.active_count == 2
+    s.stage_params(lm_new[0], "vNEW")
+    # Quiesce: nothing new admits while the stage is pending.
+    s.submit({"prompt": PROMPT, "max_new": 6})
+    s.admit()
+    assert s.active_count == 2
+    while s.busy:
+        s.admit()
+        s.step()
+    out = s.drain_ready()
+    # The straddling pair answered from its ADMISSION-TIME weights; the
+    # quiesced third request answered on the new weights after the flip.
+    assert [o["weight_version"] for o in out] == ["vOLD", "vOLD", "vNEW"]
+    assert [o["continuation"] for o in out[:2]] == [
+        w["continuation"] for w in want_old
+    ]
+    assert out[2]["continuation"] == want_new[0]["continuation"]
+    assert s.weight_version == "vNEW"
+    assert s.consume_swap_events() == [{"ok": True, "version": "vNEW"}]
+    # Rollback: the old pair never left the device.
+    assert s.stage_rollback() == "vOLD"
+    s.step()
+    assert s.weight_version == "vOLD"
+    out = s.run([dict(r) for r in reqs])
+    assert [o["continuation"] for o in out] == [
+        w["continuation"] for w in want_old
+    ]
+    after = {
+        "step": _cache_size(smod._pool_step),
+        "prefill": _cache_size(smod._slot_prefill),
+        "pick": _cache_size(smod._pick_pool),
+    }
+    assert after == before, f"swap minted new programs: {before} -> {after}"
+
+
+def test_stage_params_refuses_structural_mismatch(lm):
+    from transformer_tpu.serve import ContinuousScheduler
+    from transformer_tpu.serve.replica import build_model_from_spec
+
+    params, cfg, tok = lm
+    s = ContinuousScheduler(params, cfg, tok, num_slots=1)
+    other, _, _ = build_model_from_spec(
+        {**SPEC, "config": {**SPEC["config"], "d_model": 32, "dff": 64}}
+    )
+    with pytest.raises(ValueError, match="mismatch|structure"):
+        s.stage_params(other, "vBAD")
+    assert not s.swap_pending
+
+
+# --------------------------------------------------------------------------
+# fake-link fleet drills (fast, deterministic — the chaos subset)
+
+
+class _FakeReplica(ReplicaLink):
+    """A scripted worker speaking the upgrade protocol: answers carry its
+    CURRENT version, upgrade/rollback messages flip it (confirming like a
+    drained scheduler would), and ``die_on_upgrade`` simulates a SIGKILL
+    after the swap message was delivered but before any confirmation."""
+
+    def __init__(self, index, name, version="vOLD"):
+        super().__init__(index, name)
+        self.wv = version
+        self.cur = version
+        self.router = None
+        self.ok = True
+        self.die_on_upgrade = False
+        self.upgrades_seen = []
+
+    def alive(self):
+        return self.ok
+
+    def kill(self):
+        self.ok = False
+
+    def send(self, msg):
+        if not self.ok:
+            raise BrokenPipeError("dead")
+        kind = msg.get("type")
+        if kind == "req":
+            self.router.inbox.put((self.index, {
+                "type": "answer", "rid": msg["rid"],
+                "resp": {"continuation": f"{self.name}:{self.cur}",
+                         "weight_version": self.cur},
+                "slo": {"ttft_s": 0.01, "total_s": 0.02},
+            }))
+        elif kind == "upgrade":
+            self.upgrades_seen.append(dict(msg))
+            if self.die_on_upgrade:
+                self.ok = False
+                self.router.inbox.put((self.index, {"type": "exit"}))
+                return
+            self.cur = msg["version"]
+            self.router.inbox.put((self.index, {
+                "type": "upgrade_staged", "ok": True,
+                "version": msg["version"],
+            }))
+            self.router.inbox.put((self.index, {
+                "type": "upgraded", "ok": True, "version": msg["version"],
+            }))
+        elif kind == "rollback":
+            self.cur = "vOLD"
+            self.router.inbox.put((self.index, {
+                "type": "upgraded", "ok": True, "version": "vOLD",
+            }))
+        elif kind == "export_state":
+            self.router.inbox.put(
+                (self.index, {"type": "prefix_state", "entries": []})
+            )
+
+
+def _fake_fleet(n=2, *, upgrader, supervisor=None, telemetry=None, **kw):
+    links = [_FakeReplica(i, f"f{i}") for i in range(n)]
+    router = Router(
+        links, encode=None, upgrader=upgrader, supervisor=supervisor,
+        telemetry=telemetry, **kw,
+    )
+    for link in links:
+        link.router = router
+    return router, links
+
+
+def _drive(router, up, until, max_iters=200):
+    for _ in range(max_iters):
+        router.pump(timeout=0)
+        if until():
+            return
+    raise AssertionError(f"coordinator stuck in state {up.state}")
+
+
+@pytest.mark.chaos
+def test_corrupt_checkpoint_rejected_before_any_replica_swaps(tmp_path):
+    """Integrity at the door: a checkpoint whose manifest fails
+    verification is refused FLEET-WIDE — a structured `upgrade` error, a
+    route.upgrade rejected event, zero swap messages sent, serving
+    untouched."""
+    from transformer_tpu.train.checkpoint import CheckpointManager
+
+    path = CheckpointManager(str(tmp_path), is_primary=True).save(
+        {"w": np.zeros((2,), np.float32)}, step=1
+    )
+    # Garble the manifest (digest mismatch): real verify_checkpoint runs.
+    mpath = os.path.join(path, "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest["arrays"]["w"]["crc32"] ^= 0xFF
+    json.dump(manifest, open(mpath, "w"))
+
+    buf = io.StringIO()
+    telemetry = Telemetry(events=EventLog(buf))
+    up = UpgradeCoordinator()
+    router, links = _fake_fleet(2, upgrader=up, telemetry=telemetry)
+    status = router.start_upgrade(str(tmp_path))
+    assert status["ok"] is False and status["code"] == "upgrade"
+    assert "digest" in status["error"] or "crc32" in status["error"], status
+    assert up.state == "idle"
+    assert up.stats["rejected"] == 1
+    assert all(not l.upgrades_seen for l in links), (
+        "a replica was touched by a rejected rollout"
+    )
+    assert router.weight_target is None
+    # Serving is untouched.
+    out = router.run([{"prompt": "p"}] * 3)
+    assert all("continuation" in o for o in out)
+    telemetry.maybe_flush(force=True)
+    rejected = [
+        e for e in _events(buf)
+        if e.get("kind") == "route.upgrade" and e.get("phase") == "rejected"
+    ]
+    assert len(rejected) == 1 and rejected[0]["error"]
+
+
+@pytest.mark.chaos
+def test_canary_rollback_on_injected_burn():
+    """The auto-rollback ladder: route.canary marks every canary answer
+    bad in the per-version SLO split, burn > 1 sustains across the
+    windows, and the fleet converges BACK to the old version with the
+    burn evidence in route.upgrade rolled_back=true — zero lost
+    requests."""
+    from transformer_tpu.serve.resilience import FaultPlane, install
+
+    buf = io.StringIO()
+    telemetry = Telemetry(events=EventLog(buf))
+    up = UpgradeCoordinator(
+        canary_window_s=30.0, canary_min_requests=2,
+        verify=lambda p: (p, "vNEW"),
+    )
+    router, links = _fake_fleet(2, upgrader=up, telemetry=telemetry)
+    want = router.run([{"prompt": "p"}] * 2)
+    assert all(o["weight_version"] == "vOLD" for o in want)
+    install(FaultPlane.parse("route.canary:p=1,seed=7"))
+    try:
+        assert router.start_upgrade("/ckpt")["ok"]
+        _drive(router, up, lambda: up.state == "canary")
+        out = router.run([{"prompt": "p"}] * 8)
+        assert len(out) == 8 and all("continuation" in o for o in out)
+        _drive(router, up, lambda: up.state in ("rolled_back", "failed"))
+    finally:
+        install(None)
+    assert up.state == "rolled_back", up.state
+    assert up.stats["rollbacks"] == 1
+    assert up.stats["injected_canary_burn"] > 0
+    assert all(l.wv == "vOLD" and l.cur == "vOLD" for l in links)
+    assert router.weight_target is None, (
+        "a rolled-back rollout left the respawn target pointing at the "
+        "bad version"
+    )
+    # Post-rollback serving is back on the old weights, nothing lost.
+    out = router.run([{"prompt": "p"}] * 3)
+    assert all(o["weight_version"] == "vOLD" for o in out)
+    telemetry.maybe_flush(force=True)
+    events = _events(buf)
+    rb = [e for e in events if e.get("rolled_back")]
+    assert len(rb) == 1
+    assert rb[0]["version"] == "vNEW"
+    assert rb[0]["evidence"], "rollback carried no burn evidence"
+    assert "burn" in rb[0]["reason"]
+    # The canary's pinned slice was deterministic and observed.
+    assert up.stats["canary_requests"] > 0
+
+
+@pytest.mark.chaos
+def test_mid_swap_death_respawns_at_target_version():
+    """SIGKILL mid-swap: the victim dies after the upgrade message lands
+    but before confirming. The rollout continues, and the supervisor
+    respawns the index AT THE FLEET'S TARGET VERSION (the 4-arg spawn
+    recipe receives Router.weight_target) — the stale-respawn fix."""
+    clk = [0.0]
+    spawn_targets = []
+
+    def spawn(index, name, role, weight_target=None):
+        spawn_targets.append(weight_target)
+        link = _FakeReplica(
+            index, name,
+            version=weight_target[1] if weight_target else "vOLD",
+        )
+        link.cur = link.wv
+        link.router = router
+        router.inbox.put((index, {
+            "type": "ready", "replica": name, "weight_version": link.wv,
+        }))
+        return link
+
+    sup = Supervisor(spawn, backoff_ms=0.0, clock=lambda: clk[0])
+    up = UpgradeCoordinator(
+        canary_window_s=0.0, canary_min_requests=1,
+        verify=lambda p: (p, "vNEW"),
+    )
+    router, links = _fake_fleet(2, upgrader=up, supervisor=sup)
+    links[1].die_on_upgrade = True
+    assert router.start_upgrade("/ckpt")["ok"]
+
+    def converged():
+        clk[0] += 1.0
+        return (
+            up.state == "done"
+            and sup.stats["respawns"] == 1
+            and all(not l.dead and l.wv == "vNEW" for l in router.links)
+        )
+
+    _drive(router, up, converged)
+    assert spawn_targets == [("/ckpt", "vNEW")], spawn_targets
+    # The replacement answers at the target version, like the upgraded
+    # survivor — byte-consistency per tag holds across the heal.
+    out = router.run([{"prompt": "p"}] * 4)
+    assert all(o["weight_version"] == "vNEW" for o in out), out
+    assert up.stats["rollbacks"] == 0
+
+
+@pytest.mark.chaos
+def test_route_upgrade_fault_aborts_and_rolls_back():
+    """The route.upgrade injection point: the SECOND per-replica swap
+    dispatch faults, the rollout aborts, and the already-upgraded canary
+    rolls back — the fleet is never left half-upgraded."""
+    from transformer_tpu.serve.resilience import FaultPlane, install
+
+    up = UpgradeCoordinator(
+        canary_window_s=0.0, canary_min_requests=1,
+        verify=lambda p: (p, "vNEW"),
+    )
+    router, links = _fake_fleet(2, upgrader=up)
+    install(FaultPlane.parse("route.upgrade:at=2"))
+    try:
+        assert router.start_upgrade("/ckpt")["ok"]
+        _drive(router, up, lambda: up.state in ("failed", "rolled_back"))
+    finally:
+        install(None)
+    assert up.state == "failed", up.state
+    assert all(l.cur == "vOLD" for l in links), (
+        "abort left a replica on the new weights"
+    )
+    assert router.weight_target is None
+    out = router.run([{"prompt": "p"}] * 3)
+    assert all(o["weight_version"] == "vOLD" for o in out)
+
+
+@pytest.mark.chaos
+def test_dead_canary_rolls_back_instead_of_starved_promotion():
+    """A canary that dies on the new weights and never recovers must read
+    as a ROLLBACK signal: burn stays 0 (failovers answer on old-version
+    survivors), so the traffic-starvation escape must not promote the
+    crashing version fleet-wide."""
+    clk = [100.0]
+    up = UpgradeCoordinator(
+        canary_window_s=1.0, canary_min_requests=1,
+        verify=lambda p: (p, "vNEW"), clock=lambda: clk[0],
+    )
+    router, links = _fake_fleet(2, upgrader=up)
+    assert router.start_upgrade("/ckpt")["ok"]
+    _drive(router, up, lambda: up.state == "canary")
+    # The canary dies right after its swap; no supervisor, no recovery.
+    links[0].ok = False
+    router.inbox.put((0, {"type": "exit"}))
+    router.pump(timeout=0)
+    assert links[0].dead
+
+    def resolved():
+        clk[0] += 1.0
+        return up.state in ("rolled_back", "failed", "done", "rolling")
+
+    _drive(router, up, resolved)
+    assert up.state == "rolled_back", up.state
+    assert "did not recover" in up._rollback_reason
+    # The survivor was never upgraded; the target is cleared.
+    assert links[1].cur == "vOLD"
+    assert router.weight_target is None
+
+
+@pytest.mark.chaos
+def test_late_swap_confirmation_after_rollback_converges():
+    """A swap confirmation that lands AFTER the rollout rolled back (the
+    quiesced flip raced the abort) must be converged back to the old
+    version — a half-upgraded fleet is never left behind."""
+    from transformer_tpu.serve.resilience import FaultPlane, install
+
+    up = UpgradeCoordinator(
+        canary_window_s=30.0, canary_min_requests=1,
+        verify=lambda p: (p, "vNEW"),
+    )
+    router, links = _fake_fleet(2, upgrader=up)
+    # Delay replica 0's confirmations: it stages silently and confirms
+    # only when the test releases them.
+    held = []
+    orig_send = links[0].send
+
+    def holding_send(msg, _orig=orig_send):
+        if msg.get("type") == "upgrade":
+            links[0].upgrades_seen.append(dict(msg))
+            links[0].cur = msg["version"]
+            held.append({
+                "type": "upgraded", "ok": True, "version": msg["version"],
+            })
+            return
+        _orig(msg)
+
+    links[0].send = holding_send
+    install(FaultPlane.parse("route.canary:p=1,seed=3"))
+    try:
+        assert router.start_upgrade("/ckpt")["ok"]
+        # replica 0 quiesces and receives the swap but never confirms;
+        # drive until the coordinator is waiting in "swap".
+        _drive(router, up, lambda: up.state == "swap")
+        assert links[0].upgrades_seen
+        # Force the rollback decision while the confirmation is in
+        # flight (injected canary burn cannot fire yet — the canary never
+        # formed — so use the swap-timeout abort path via a late clock).
+        up._abort("simulated mid-rollout abort")
+        assert up.state in ("rolling_back", "failed")
+        # The held confirmation now lands: the coordinator must converge
+        # replica 0 back instead of leaving it on vNEW.
+        for msg in held:
+            router.inbox.put((0, msg))
+        _drive(router, up, lambda: up.state in ("failed", "rolled_back"))
+    finally:
+        install(None)
+    assert links[0].cur == "vOLD", (
+        "late confirmation left the replica on the new weights"
+    )
+    assert all(l.cur == "vOLD" for l in links)
+    assert up.state == "failed", up.state
+    # And a surrendered rollout never resumes from its stale queue.
+    for _ in range(5):
+        router.pump(timeout=0)
+    assert up.state == "failed"
+    assert all(l.cur == "vOLD" for l in links)
+
+
+def test_canary_every_defaults_to_fleet_size():
+    """canary_every=0 means 1/fleet-size — the LIVE fleet, not the
+    not-yet-converged roster (a respawn that already converged still
+    counts toward the canary's fair share)."""
+    up = UpgradeCoordinator(verify=lambda p: (p, "vNEW"))
+    router, links = _fake_fleet(3, upgrader=up)
+    links[2].wv = links[2].cur = "vNEW"  # already converged
+    assert router.start_upgrade("/ckpt")["ok"]
+    assert up._canary_every == 3, up._canary_every
+
+
+def test_router_without_coordinator_refuses_upgrade():
+    up = None
+    links = [_FakeReplica(0, "f0")]
+    router = Router(links, encode=None)
+    links[0].router = router
+    status = router.start_upgrade("/ckpt")
+    assert status["ok"] is False and status["code"] == "upgrade"
+
+
+# --------------------------------------------------------------------------
+# the acceptance soak: a real subprocess fleet, rolling swap under live
+# traffic, then a post-upgrade SIGKILL heal at the target version
+
+
+def test_rolling_upgrade_subprocess_soak(lm, lm_new, spec_file, ckpts):
+    """The ISSUE acceptance drill: 2 replica processes serving a live
+    stream while a verified rolling swap walks the fleet (quiesce ->
+    double-buffered swap -> canary -> promote). Every request answers
+    exactly once, every answer is tagged with its admission-time
+    weight_version, the mixed-version fleet stays byte-consistent per
+    tag, and a post-rollout SIGKILL heals at the TARGET version."""
+    old_dir, new_dir = ckpts
+    old_version = verify_checkpoint(old_dir)[1]
+    new_version = verify_checkpoint(new_dir)[1]
+    params, cfg, tok = lm
+    reqs = [{"prompt": PROMPT, "max_new": 6}] * 14
+    want_old = _reference(lm, reqs[:1])[0]["continuation"]
+    want_new = _reference(lm_new, reqs[:1])[0]["continuation"]
+    assert want_old != want_new
+
+    worker = [
+        "--model_spec", spec_file, "--init_ckpt", old_dir,
+        "--serve_slots", "2", "--heartbeat_ms", "50",
+    ]
+    links = [ReplicaProcess.spawn(i, list(worker)) for i in range(2)]
+
+    def spawn(index, name, role, weight_target=None):
+        argv = list(worker)
+        if weight_target is not None:
+            # Replace the bootstrap checkpoint with the fleet's target.
+            argv[argv.index("--init_ckpt") + 1] = weight_target[0]
+            argv += ["--weight_version", weight_target[1]]
+        return ReplicaProcess.spawn(index, argv, role=role, name=name)
+
+    sup = Supervisor(spawn, backoff_ms=50.0)
+    up = UpgradeCoordinator(canary_window_s=0.3, canary_min_requests=1)
+    buf = io.StringIO()
+    telemetry = Telemetry(events=EventLog(buf))
+    router = Router(
+        links, encode=tok.encode, bos_id=tok.bos_id, affinity_block=4,
+        heartbeat_timeout_s=10.0, telemetry=telemetry,
+        supervisor=sup, upgrader=up,
+    )
+    for link in links:
+        link.start_reader(router.inbox)
+
+    answered = []
+    deadline = time.time() + 110
+    try:
+        # LIVE traffic in two phases: the first 8 requests flow before
+        # (and straddle into) the rollout — all admitted on the old
+        # weights; the remaining 6 are held until the canary is serving,
+        # so the mixed-version window genuinely carries traffic.
+        next_req = 0
+        started = False
+        while (
+            len(answered) < len(reqs) or (started and up.active)
+        ) and time.time() < deadline:
+            feed_cap = 8 if up.state in ("idle", "quiesce", "swap") else (
+                len(reqs)
+            )
+            while next_req < min(feed_cap, len(reqs)) and router.backlog < 3:
+                router.submit(dict(reqs[next_req]))
+                next_req += 1
+            router.pump()
+            answered.extend(router.drain_ready())
+            if not started and len(answered) >= 2:
+                status = router.start_upgrade(new_dir)
+                assert status["ok"], status
+                assert status["version"] == new_version
+                started = True
+        assert up.state == "done", (up.state, up.stats)
+        assert len(answered) == len(reqs)
+        # Byte-consistency per weight_version tag, zero errors.
+        by_version = {}
+        for a in answered:
+            assert "continuation" in a, f"request errored: {a}"
+            by_version.setdefault(a["weight_version"], set()).add(
+                a["continuation"]
+            )
+        assert set(by_version) == {old_version, new_version}, (
+            f"expected a mixed-version stream, got {sorted(by_version)}"
+        )
+        assert by_version[old_version] == {want_old}
+        assert by_version[new_version] == {want_new}
+        assert router.weight_target == (new_dir, new_version)
+        assert all(l.wv == new_version for l in router.links)
+
+        # ---- post-upgrade SIGKILL: the respawn-at-target regression ----
+        # Kill the AFFINE owner of the test prompt (most answers) so the
+        # replacement — same name, same rendezvous keys — takes traffic.
+        victim = max(router.links, key=lambda l: l.answered)
+        os.kill(victim.pid(), signal.SIGKILL)
+        while time.time() < deadline:
+            router.pump()
+            if (
+                sup.stats["respawns"] == 1
+                and len(router.healthy_links) == 2
+            ):
+                break
+        assert sup.stats["respawns"] == 1, sup.stats
+        replacement = router.links[victim.index]
+        assert replacement is not victim
+        assert replacement.wv == new_version, (
+            "the replacement resurrected stale weights "
+            f"(wv={replacement.wv!r})"
+        )
+        # The replacement answers byte-identically to upgraded survivors.
+        out2 = router.run([dict(r) for r in reqs[:4]])
+        assert [o.get("continuation") for o in out2] == [want_new] * 4
+        assert all(o["weight_version"] == new_version for o in out2)
+        assert replacement.answered > 0, "replacement took no traffic"
+    finally:
+        router.shutdown()
+        telemetry.maybe_flush(force=True)
+
+    events = _events(buf)
+    phases = [
+        (e.get("phase"), e.get("replica"))
+        for e in events if e.get("kind") == "route.upgrade"
+    ]
+    assert ("started", None) in phases
+    assert sum(1 for p, _ in phases if p == "swapped") == 2
+    assert any(p == "completed" for p, _ in phases)
+    canary = [e for e in events if e.get("kind") == "route.canary"]
+    assert [e["phase"] for e in canary] == ["started", "promoted"]
+    completed = [
+        e for e in events
+        if e.get("kind") == "route.upgrade" and e.get("phase") == "completed"
+    ]
+    assert completed[0]["time_to_upgrade_s"] > 0
+    # The merged report renders the upgrade section from the same stream.
+    from transformer_tpu.obs.__main__ import render_text, summarize_events
+
+    report = summarize_events(events)
+    upgrade = report["upgrade"]
+    assert upgrade["completed"] == 1
+    assert upgrade["rollbacks"] == 0
+    assert upgrade["version"] == new_version
+    assert upgrade["canary"]["promoted"] is True
+    share = upgrade["per_version_requests"]
+    assert old_version in share and new_version in share
+    assert "upgrade:" in render_text(report)
+
+
+# --------------------------------------------------------------------------
+# obs + analysis surfaces
+
+
+def test_summarize_upgrade_section_shapes():
+    from transformer_tpu.obs.__main__ import render_text, summarize_events
+
+    events = [
+        {"kind": "route.upgrade", "phase": "started", "version": "v2",
+         "ckpt": "/c", "replicas": ["r0", "r1"], "ts": 1.0},
+        {"kind": "route.dispatch", "order": 0, "replica": "r0",
+         "weight_version": "v1", "redispatch": 0, "ts": 1.1},
+        {"kind": "route.canary", "phase": "started", "replica": "r0",
+         "version": "v2", "every": 2, "window_s": 5.0, "ts": 1.2},
+        {"kind": "route.dispatch", "order": 1, "replica": "r0",
+         "weight_version": "v2", "redispatch": 0, "ts": 1.3},
+        {"kind": "route.upgrade", "phase": "rolled_back",
+         "rolled_back": True, "version": "v2",
+         "reason": "canary burn > 1 sustained on availability",
+         "evidence": {"availability": {"5s": 40.0}}, "ts": 2.0},
+    ]
+    up = summarize_events(events)["upgrade"]
+    assert up["started"] == 1 and up["rollbacks"] == 1
+    assert up["rollback"]["evidence"]
+    assert up["canary"]["promoted"] is False
+    assert up["per_version_requests"]["v1"]["requests"] == 1
+    assert up["per_version_requests"]["v2"]["share"] == 0.5
+    text = render_text(summarize_events(events))
+    assert "upgrade:" in text and "rolled back" in text
+    assert "version v1" in text and "version v2" in text
+
+
+@pytest.mark.slow
+def test_upgrade_retrace_zero_recompiles():
+    """0 steady-state recompiles across quiesce/swap/rollback — the same
+    scenario the `analysis retrace` CLI (and the tier-1 analysis-all
+    gate) runs."""
+    from transformer_tpu.analysis.retrace import upgrade_retrace_report
+
+    deltas = upgrade_retrace_report(steps=2)
+    assert deltas and all(d.within_budget for d in deltas), [
+        d.to_dict() for d in deltas
+    ]

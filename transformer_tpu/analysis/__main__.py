@@ -261,6 +261,7 @@ def _cmd_retrace(args: argparse.Namespace) -> int:
         resilience_retrace_report,
         speculative_retrace_report,
         train_retrace_report,
+        upgrade_retrace_report,
     )
 
     deltas = (
@@ -269,6 +270,7 @@ def _cmd_retrace(args: argparse.Namespace) -> int:
         + prefix_cache_retrace_report(steps=args.steps)
         + paged_retrace_report(steps=args.steps)
         + resilience_retrace_report(steps=args.steps)
+        + upgrade_retrace_report(steps=args.steps)
         + train_retrace_report(steps=args.steps)
     )
     ok = all(d.within_budget for d in deltas)

@@ -416,6 +416,74 @@ def resilience_retrace_report(steps: int = 3) -> list[WatchDelta]:
     return sentinel.deltas()
 
 
+def upgrade_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state serving ACROSS live-weight swaps: requests are
+    admitted, a structural-twin weight set is staged mid-flight (the
+    quiesce), the pool drains on the admission-time weights, the flip
+    lands at a drained step boundary, new traffic serves the new weights,
+    and a rollback re-stages the resident old pair — and through the
+    whole quiesce/swap/rollback ladder the hot paths (``_pool_step``,
+    ``_slot_prefill``, ``_pick_pool``) must compile ZERO new programs:
+    params are traced operands of the same executables, so a verified
+    twin only changes VALUES (docs/SERVING.md "Live-weights rollout").
+    Answers are asserted byte-stable per weight_version tag."""
+    from transformer_tpu.models.transformer import transformer_init
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg, params, tok = _tiny_lm_setup()
+    params_new = transformer_init(jax.random.PRNGKey(1), cfg)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=32, default_max_new=4,
+        weight_version="v0",
+    )
+    wave = [
+        {"prompt": "the quick brown fox"}, {"prompt": "the lazy dog"},
+    ]
+    want_old = s.run([dict(r) for r in wave])  # warmup compile on v0
+    sentinel = RetraceSentinel()
+    sentinel.watch("decode_step(_pool_step)", sched._pool_step, budget=0)
+    sentinel.watch("_slot_prefill", sched._slot_prefill, budget=0)
+    sentinel.watch("pick(_pick_pool)", sched._pick_pool, budget=0)
+    sentinel.snapshot()
+    want_new = None
+    for i in range(steps):
+        # Straddle the boundary: admit the wave on v0, THEN stage v1 —
+        # the in-flight requests must finish on their admission-time
+        # weights while admission quiesces.
+        for r in wave:
+            s.submit(dict(r))
+        s.admit()
+        assert s.active_count == len(wave), "wave not admitted pre-stage"
+        s.stage_params(params_new, "v1")
+        while s.busy:
+            s.admit()
+            s.step()
+        out = s.drain_ready()
+        assert [r["continuation"] for r in out] == [
+            r["continuation"] for r in want_old
+        ], f"round {i}: straddling requests left their admission weights"
+        assert all(r["weight_version"] == "v0" for r in out)
+        s.step()  # the drained boundary: the flip lands here
+        assert s.weight_version == "v1", "swap did not land"
+        out = s.run([dict(r) for r in wave])
+        assert all(r["weight_version"] == "v1" for r in out)
+        if want_new is None:
+            want_new = out
+        else:
+            assert [r["continuation"] for r in out] == [
+                r["continuation"] for r in want_new
+            ], f"round {i}: v1 answers drifted"
+        s.stage_rollback()
+        s.step()
+        assert s.weight_version == "v0", "rollback did not land"
+        out = s.run([dict(r) for r in wave])
+        assert [r["continuation"] for r in out] == [
+            r["continuation"] for r in want_old
+        ], f"round {i}: rollback changed v0 answers"
+    return sentinel.deltas()
+
+
 def train_retrace_report(steps: int = 3) -> list[WatchDelta]:
     """Steady-state training: one warmup step compiles; ``steps`` more
     same-shaped steps must not."""

@@ -1033,11 +1033,22 @@ def _scenario_supervisor_respawn(sched: DetScheduler):
     from transformer_tpu.serve.router import ReplicaLink, Router
     from transformer_tpu.serve.supervisor import Supervisor
 
+    pids = iter(range(1000, 2000))
+
     class _Scripted(ReplicaLink):
         def __init__(self, index, name, mailbox):
             super().__init__(index, name)
             self.mailbox = mailbox
             self.ok = True
+            # Scripted "process identity" for the exit sentinel — without
+            # it, a schedule where the respawn lands before the sentinel
+            # drains would fail over the REPLACEMENT (the confusion the
+            # router's pid check exists to prevent) and then sit out the
+            # breaker cooldown in real time.
+            self._pid = next(pids)
+
+        def pid(self):
+            return self._pid
 
         def send(self, msg):
             self.mailbox.put(msg)
@@ -1075,9 +1086,10 @@ def _scenario_supervisor_respawn(sched: DetScheduler):
     def client():
         for i in range(N):
             router.submit({"prompt": f"p{i}"})
-        # Replica 0 dies with whatever the dispatcher already handed it.
+        # Replica 0 dies with whatever the dispatcher already handed it;
+        # the sentinel carries its pid (see _Scripted.pid).
         links[0].ok = False
-        router.inbox.put((0, {"type": "exit"}))
+        router.inbox.put((0, {"type": "exit", "pid": links[0].pid()}))
 
     def survivor():
         while True:
@@ -1146,6 +1158,183 @@ def _scenario_supervisor_respawn(sched: DetScheduler):
         assert sup._slots[0].phase == "up", sup._slots[0].phase
 
     return [client, pump, survivor, newbie], check
+
+
+def _scenario_rolling_upgrade(sched: DetScheduler):
+    """The live-weights control plane under adversarial interleaving: a
+    CLIENT submitting orders then SIGKILLing replica 1, the ROUTER pump
+    (which drives the UpgradeCoordinator's quiesce/swap state machine,
+    the supervisor's respawn machine, AND the answer funnel), scripted
+    replica workers speaking the upgrade protocol, and the REPLACEMENT
+    the supervisor spawns mid-rollout. No matter how death, failover,
+    swap confirmations, and respawn interleave: no request is lost, no
+    replica ever stages a version the coordinator did not verify, the
+    respawn bootstraps at the fleet's TARGET version (never the stale
+    argv weights), and the fleet's final version set is re-derived
+    exactly — every live link at the target."""
+    from transformer_tpu.serve.router import ReplicaLink, Router
+    from transformer_tpu.serve.supervisor import Supervisor
+    from transformer_tpu.serve.upgrade import UpgradeCoordinator
+
+    pids = iter(range(1000, 2000))
+
+    class _Scripted(ReplicaLink):
+        def __init__(self, index, name, mailbox, version="vOLD"):
+            super().__init__(index, name)
+            self.mailbox = mailbox
+            self.ok = True
+            self.wv = version
+            # Scripted "process identity": the exit sentinel carries it,
+            # like ReplicaProcess's pid — without it, a stale EOF racing
+            # the respawn would fail over the REPLACEMENT (the exact
+            # confusion the router's pid check exists to prevent).
+            self._pid = next(pids)
+
+        def pid(self):
+            return self._pid
+
+        def send(self, msg):
+            if not self.ok:
+                raise BrokenPipeError("dead")
+            self.mailbox.put(msg)
+
+        def alive(self):
+            return self.ok
+
+        def kill(self):
+            self.ok = False
+
+    mailboxes = [DetQueue(sched), DetQueue(sched)]
+    newbie_mailbox = DetQueue(sched)
+    links = [_Scripted(i, f"r{i}", mailboxes[i]) for i in range(2)]
+    upgrade_msgs: list = []
+    spawn_targets: list = []
+
+    def spawn(index, name, role, weight_target=None):
+        # The 4-arg recipe: the supervisor hands over the fleet's target
+        # so the replacement "process" bootstraps at the CURRENT version.
+        spawn_targets.append(weight_target)
+        version = weight_target[1] if weight_target else "vOLD"
+        link = _Scripted(index, name, newbie_mailbox, version=version)
+        ready = {"type": "ready", "replica": name,
+                 "weight_version": version}
+        router.inbox.put((index, ready))
+        return link
+
+    sup = Supervisor(
+        spawn, backoff_ms=0.0, boot_timeout_s=300.0, warm_timeout_s=300.0,
+    )
+    # canary_window_s=0: the canary gate promotes on its first poll — the
+    # verdict math is pinned by tests/test_upgrade.py; this scenario
+    # explores the COORDINATION interleavings.
+    up = UpgradeCoordinator(
+        canary_window_s=0.0, canary_min_requests=1,
+        verify=lambda p: (p, "vNEW"),
+    )
+    router = Router(
+        links, encode=lambda s: [3, 4, 5, 6, 7, 8, 9, 10], bos_id=1,
+        affinity_block=4, supervisor=sup, upgrader=up,
+    )
+    N = 3
+    drained: list = []
+
+    def client():
+        for i in range(N):
+            router.submit({"prompt": f"p{i}"})
+        # Replica 1 dies with whatever it holds — possibly mid-quiesce,
+        # mid-swap, or already upgraded, depending on the schedule. The
+        # sentinel carries the dying process's pid so a schedule where
+        # the respawn lands first cannot fail over the replacement.
+        links[1].ok = False
+        router.inbox.put((1, {"type": "exit", "pid": links[1].pid()}))
+
+    def replica_body(index, mailbox, version):
+        ver = [version]
+
+        def body():
+            while True:
+                msg = mailbox.get()
+                kind = msg.get("type")
+                if kind == "shutdown":
+                    return
+                if kind == "export_state":
+                    router.inbox.put(
+                        (index, {"type": "prefix_state", "entries": []})
+                    )
+                elif kind == "inject_state":
+                    router.inbox.put(
+                        (index, {"type": "state_injected", "tokens": 0})
+                    )
+                elif kind == "upgrade":
+                    # The scripted worker's verification stand-in: it
+                    # only ever serves versions the coordinator shipped.
+                    upgrade_msgs.append(dict(msg))
+                    router.inbox.put((index, {
+                        "type": "upgrade_staged", "ok": True,
+                        "version": msg["version"],
+                    }))
+                    ver[0] = msg["version"]
+                    router.inbox.put((index, {
+                        "type": "upgraded", "ok": True,
+                        "version": msg["version"],
+                    }))
+                elif kind == "rollback":
+                    ver[0] = "vOLD"
+                    router.inbox.put((index, {
+                        "type": "upgraded", "ok": True, "version": "vOLD",
+                    }))
+                elif kind == "req":
+                    router.inbox.put((index, {
+                        "type": "answer", "rid": msg["rid"],
+                        "resp": {"continuation": "x",
+                                 "weight_version": ver[0]},
+                        "slo": {"total_s": 0.01},
+                    }))
+
+        return body
+
+    def pump():
+        st = router.start_upgrade("ckpt")
+        assert st["ok"], st
+        while not (
+            len(drained) >= N
+            and up.state == "done"
+            and sup.stats["respawns"] >= 1
+            and all(not l.dead and l.wv == "vNEW" for l in router.links)
+        ):
+            router.pump(timeout=0.01)
+            drained.extend(router.drain_ready())
+        router.pump(timeout=0.01)
+        for mb in mailboxes:
+            mb.put({"type": "shutdown"})
+        newbie_mailbox.put({"type": "shutdown"})
+
+    def check():
+        assert len(drained) == N, f"orders lost/duplicated: {drained}"
+        assert all("error" not in d for d in drained), drained
+        # No replica ever staged an unverified version.
+        assert upgrade_msgs, "no replica was ever upgraded"
+        assert all(m["version"] == "vNEW" for m in upgrade_msgs), (
+            upgrade_msgs
+        )
+        # The respawn bootstrapped at the fleet's TARGET version — the
+        # stale-weights regression this PR fixes.
+        assert spawn_targets == [("ckpt", "vNEW")], spawn_targets
+        assert up.state == "done", up.state
+        assert up.stats["rollbacks"] == 0, up.stats
+        # Fleet version re-derived exactly: every live link at the target.
+        assert all(l.wv == "vNEW" for l in router.links), (
+            [(l.name, l.wv) for l in router.links]
+        )
+        assert router.weight_target == ("ckpt", "vNEW")
+        assert not router._inflight, "in-flight table leaked entries"
+
+    return [
+        client, pump,
+        replica_body(0, mailboxes[0], "vOLD"),
+        replica_body(1, mailboxes[1], "vOLD"),
+        replica_body(1, newbie_mailbox, "vNEW"),
+    ], check
 
 
 def _pkg_files(*modnames: str) -> list[str]:
@@ -1224,6 +1413,25 @@ CANNED: dict[str, Scenario] = {
         # seeded-random distinct traces, per the explorer's >2-thread
         # policy.
         max_schedules=24,
+        random_mode=True,
+    ),
+    "rolling_upgrade": Scenario(
+        name="rolling_upgrade",
+        setup=_scenario_rolling_upgrade,
+        modules=lambda: _pkg_modules(
+            "transformer_tpu.serve.router",
+            "transformer_tpu.serve.supervisor",
+            "transformer_tpu.serve.upgrade",
+        ),
+        instrument=lambda: _pkg_files(
+            "transformer_tpu.serve.router",
+            "transformer_tpu.serve.supervisor",
+            "transformer_tpu.serve.upgrade",
+        ),
+        # 5 threads (client / pump+coordinator+supervisor / 2 replicas /
+        # replacement): seeded-random distinct traces, >=64 per the
+        # rolling-upgrade coverage bar (docs/ANALYSIS.md).
+        max_schedules=64,
         random_mode=True,
     ),
 }

@@ -97,6 +97,13 @@ def define_router_flags() -> None:
         "bounded failover: redispatches per request before answering a "
         "structured 'transient' error")
     flags.DEFINE_float("heartbeat_ms", 200.0, "replica heartbeat period")
+    flags.DEFINE_string(
+        "fault_spec", "",
+        "deterministic fault injection (docs/ROBUSTNESS.md grammar): "
+        "installed in the ROUTER process (route.spawn/route.hb/"
+        "route.upgrade/route.canary/route.takeover fire here) AND "
+        "forwarded to every replica worker (serve.*/prefix.*/draft.*/"
+        "ckpt.swap fire there)")
     flags.DEFINE_float(
         "heartbeat_timeout", 5.0,
         "seconds without a heartbeat before a replica is failed over "
@@ -148,6 +155,24 @@ def define_router_flags() -> None:
         "router HA primary: journal intake/delivery/heartbeat events to "
         "--metrics_jsonl and give replicas takeover control sockets so a "
         "warm standby (--standby) can adopt the fleet")
+    # ---- live-weights rollout (serve/upgrade.py) --------------------------
+    flags.DEFINE_string(
+        "upgrade", "",
+        "start a rolling weight swap to this manifest-verified checkpoint "
+        "at startup (docs/SERVING.md 'Live-weights rollout'); at runtime "
+        "a control line {\"upgrade\": \"<ckpt>\"} on stdin does the same")
+    flags.DEFINE_float(
+        "canary_window", 5.0,
+        "seconds the first upgraded replica serves its pinned traffic "
+        "slice before the rollout promotes (clean) or rolls back (burn)")
+    flags.DEFINE_integer(
+        "canary_every", 0,
+        "pin every Nth accepted order to the canary during its window "
+        "(0 = the fleet size at rollout start)")
+    flags.DEFINE_string(
+        "canary_slo", "",
+        "SLO objectives for the per-weight-version canary verdict "
+        "(obs/slo.py grammar; '' = short-window availability + ttft_p95)")
     flags.DEFINE_string(
         "standby", "",
         "run as the warm STANDBY for the primary whose --metrics_jsonl is "
@@ -183,6 +208,8 @@ def worker_args_from_flags(replica_jsonl: str = "") -> list[str]:
         out += ["--metrics_jsonl", replica_jsonl]
         if FLAGS.trace:
             out += ["--trace"]
+    if FLAGS.fault_spec:
+        out += ["--fault_spec", FLAGS.fault_spec]
     if FLAGS.ha or FLAGS.standby:
         out += ["--ha"]
     return out
@@ -210,6 +237,25 @@ def route_lines(q: "queue.Queue", router) -> None:
             line = line.strip()
             if not line:
                 continue
+            if line.startswith("{") and '"upgrade"' in line:
+                # Control line: {"upgrade": "<ckpt_dir>"} starts a rolling
+                # weight swap (serve/upgrade.py) and answers the
+                # coordinator's status dict at a reserved order — the
+                # operator sees the verified version (or the structured
+                # refusal) inline with the response stream.
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    obj = None
+                if (
+                    isinstance(obj, dict) and "upgrade" in obj
+                    and "prompt" not in obj
+                ):
+                    status = router.start_upgrade(str(obj["upgrade"]))
+                    router.submit_done(
+                        {"upgrade": str(obj["upgrade"]), **status}
+                    )
+                    continue
             try:
                 req = parse_router_line(line)
             except _RouterLineError as e:
@@ -247,17 +293,23 @@ def _load_tokenizer():
 def _spawn_recipe():
     """The supervisor's deterministic re-bootstrap callable: the SAME
     worker argv the original fleet used, under the replica's old name —
-    rendezvous hashing re-offers the replacement its predecessor's keys."""
+    rendezvous hashing re-offers the replacement its predecessor's keys.
+    When a live-weights rollout has set the fleet's target
+    (``Router.weight_target``), the replacement bootstraps from that
+    checkpoint (``--init_ckpt``, manifest-verified) instead of the argv
+    weights — a heal mid- or post-rollout must never resurrect stale
+    weights."""
     from transformer_tpu.serve.router import ReplicaProcess
 
-    def spawn(index: int, name: str, role: str):
+    def spawn(index: int, name: str, role: str, weight_target=None):
         replica_jsonl = (
             f"{FLAGS.metrics_jsonl}.r{index}" if FLAGS.metrics_jsonl else ""
         )
-        return ReplicaProcess.spawn(
-            index, worker_args_from_flags(replica_jsonl), role=role,
-            name=name,
-        )
+        argv = worker_args_from_flags(replica_jsonl)
+        if weight_target is not None:
+            ckpt_dir, version = weight_target
+            argv += ["--init_ckpt", ckpt_dir, "--weight_version", version]
+        return ReplicaProcess.spawn(index, argv, role=role, name=name)
 
     return spawn
 
@@ -267,7 +319,18 @@ def _supervision_kwargs() -> dict:
     adopting standby (the standby becomes a first-class primary)."""
     from transformer_tpu.serve.supervisor import FleetScaler, Supervisor
 
-    out: dict = {}
+    from transformer_tpu.serve.upgrade import UpgradeCoordinator
+
+    out: dict = {
+        # The live-weights rollout coordinator is always attached: the
+        # --upgrade flag and the control line both drive it, and an idle
+        # coordinator costs one no-op poll per pump.
+        "upgrader": UpgradeCoordinator(
+            canary_window_s=FLAGS.canary_window,
+            canary_every=FLAGS.canary_every,
+            canary_slos=FLAGS.canary_slo or None,
+        ),
+    }
     if FLAGS.supervise:
         out["supervisor"] = Supervisor(
             _spawn_recipe(),
@@ -336,6 +399,10 @@ def main(argv) -> None:
     from transformer_tpu.cli.flags import flags_to_telemetry
     from transformer_tpu.serve.router import ReplicaProcess, Router
 
+    if FLAGS.fault_spec:
+        from transformer_tpu.serve import resilience
+
+        resilience.install(resilience.FaultPlane.parse(FLAGS.fault_spec))
     telemetry = flags_to_telemetry()
     tok = _load_tokenizer()
 
@@ -426,6 +493,15 @@ def main(argv) -> None:
         ", supervised" if FLAGS.supervise else "",
         ", HA journal on" if ha else "",
     )
+    if FLAGS.upgrade:
+        status = router.start_upgrade(FLAGS.upgrade)
+        if status.get("ok"):
+            logging.info(
+                "rolling upgrade started: %s -> version %s",
+                FLAGS.upgrade, status.get("version"),
+            )
+        else:
+            logging.error("upgrade refused: %s", status.get("error"))
     _serve_stdin(router, telemetry)
 
 

@@ -236,6 +236,63 @@ def summarize_events(events: list[dict]) -> dict:
             }
         report["fleet"] = fleet
 
+    # ---- upgrade: live-weights rollouts (serve/upgrade.py) ----------------
+    upgrades = [e for e in events if e.get("kind") == "route.upgrade"]
+    canaries = [e for e in events if e.get("kind") == "route.canary"]
+    if upgrades or canaries:
+        completed = [e for e in upgrades if e.get("phase") == "completed"]
+        rollbacks = [e for e in upgrades if e.get("rolled_back")]
+        per_version: dict[str, int] = {}
+        for d in dispatches:
+            if int(d.get("redispatch", 0) or 0) > 0:
+                continue
+            if d.get("stage") == "prefill":
+                continue
+            wv = d.get("weight_version")
+            if wv is not None:
+                per_version[str(wv)] = per_version.get(str(wv), 0) + 1
+        total_v = sum(per_version.values())
+        up: dict = {
+            "started": sum(1 for e in upgrades if e.get("phase") == "started"),
+            "completed": len(completed),
+            "rejected": sum(
+                1 for e in upgrades if e.get("phase") == "rejected"
+            ),
+            "rollbacks": len(rollbacks),
+            "replicas_swapped": sum(
+                1 for e in upgrades if e.get("phase") == "swapped"
+            ),
+            "per_version_requests": {
+                v: {
+                    "requests": n,
+                    "share": round(n / total_v, 4) if total_v else None,
+                }
+                for v, n in sorted(per_version.items())
+            },
+        }
+        if completed:
+            up["time_to_upgrade_s"] = completed[-1].get("time_to_upgrade_s")
+            up["version"] = completed[-1].get("version")
+        if rollbacks:
+            up["rollback"] = {
+                k: rollbacks[-1].get(k)
+                for k in ("version", "reason", "evidence")
+                if rollbacks[-1].get(k) is not None
+            }
+        promoted = [c for c in canaries if c.get("phase") == "promoted"]
+        started_c = [c for c in canaries if c.get("phase") == "started"]
+        if started_c:
+            up["canary"] = {
+                "replica": started_c[-1].get("replica"),
+                "every": started_c[-1].get("every"),
+                "window_s": started_c[-1].get("window_s"),
+                "promoted": bool(promoted),
+                "requests": (
+                    promoted[-1].get("requests") if promoted else None
+                ),
+            }
+        report["upgrade"] = up
+
     # ---- serve: grouped-path batches --------------------------------------
     batches = [e for e in events if e.get("kind") == "serve.batch"]
     if batches:
@@ -561,6 +618,41 @@ def render_text(report: dict) -> str:
                 )
             parts.append(part)
         lines.append("fleet: " + "; ".join(parts))
+    upgrade = report.get("upgrade")
+    if upgrade:
+        parts = []
+        if upgrade.get("completed"):
+            part = f"{upgrade['completed']} rollout(s) completed"
+            if upgrade.get("time_to_upgrade_s") is not None:
+                part += (
+                    f" (last {_fmt_s(upgrade['time_to_upgrade_s'])} "
+                    f"to version {upgrade.get('version')})"
+                )
+            parts.append(part)
+        elif upgrade.get("started"):
+            parts.append(f"{upgrade['started']} rollout(s) started")
+        if upgrade.get("rollbacks"):
+            rb = upgrade.get("rollback", {})
+            part = f"{upgrade['rollbacks']} rolled back"
+            if rb.get("reason"):
+                part += f" ({rb['reason']})"
+            parts.append(part)
+        if upgrade.get("rejected"):
+            parts.append(f"{upgrade['rejected']} rejected at verification")
+        canary = upgrade.get("canary")
+        if canary:
+            verdict = "promoted" if canary.get("promoted") else "pending"
+            parts.append(
+                f"canary {canary.get('replica')} every "
+                f"{canary.get('every')}th order, {verdict}"
+            )
+        lines.append("upgrade: " + "; ".join(parts))
+        for v, rep in upgrade.get("per_version_requests", {}).items():
+            share = (
+                f" ({rep['share'] * 100:.1f}%)"
+                if rep.get("share") is not None else ""
+            )
+            lines.append(f"  version {v}: {rep['requests']} requests{share}")
     grouped = report.get("serve_grouped")
     if grouped:
         line = (

@@ -43,6 +43,18 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
 - ``route.takeover`` — emitted once by an adopting standby: the new
   ``epoch``, adopted/failed replicas, and how every undelivered order
   was resolved (recovered / re-owned / re-dispatched).
+- ``route.upgrade`` / ``route.canary`` — the live-weights control plane
+  (``serve/upgrade.py``): rollout lifecycle events tagged by ``phase``
+  (``started``/``swapped``/``completed``/``rejected``/``failed``/
+  ``rolled_back``) carrying the target ``version`` (checkpoint manifest
+  digest), per-replica quiesce/swap seconds, ``time_to_upgrade_s``, and —
+  on a rollback — ``rolled_back=true`` with the per-window burn
+  ``evidence`` that triggered it; canary lifecycle (``started``/
+  ``promoted``) with the pinned slice (``every``), window, and request
+  count. ``route.dispatch`` additionally carries each dispatch's
+  ``weight_version``, so ``obs summarize --merge`` renders the upgrade
+  section (per-version request share, canary window, rollbacks,
+  time-to-upgrade) from the same stream.
 - ``metrics.snapshot`` — periodic full registry dump (histograms as
   count/sum/min/max/p50/p95/p99).
 - ``bench.relay_probe`` / ``bench.fallback_row`` / ``bench.attempt`` —

@@ -77,7 +77,11 @@ class Telemetry:
         self.events = events
         self.prom_path = prom_path
         self.interval = max(float(interval), 0.0)
-        self._last_flush = 0.0
+        # -inf, not 0.0: perf_counter's epoch is unspecified (host boot on
+        # Linux), so "now - 0 < interval" would skip the first flush on any
+        # machine whose uptime is shorter than the interval — the first
+        # flush must ALWAYS run.
+        self._last_flush = float("-inf")
         self._server = None
         self.tracer = None
         if trace:

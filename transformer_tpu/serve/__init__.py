@@ -10,7 +10,10 @@ and the circuit-breaker degradation ladder
 multi-replica serving tier: a prefix-affinity front-end router with
 zero-loss failover over replica worker processes
 (``transformer_tpu/serve/router.py`` / ``replica.py``,
-docs/SERVING.md "Multi-replica router")."""
+docs/SERVING.md "Multi-replica router") — and the live-weights control
+plane: router-coordinated rolling checkpoint swaps with canary gating and
+SLO-driven auto-rollback (``transformer_tpu/serve/upgrade.py``,
+docs/SERVING.md "Live-weights rollout")."""
 
 from transformer_tpu.serve.prefix_cache import (
     PrefixCache,
@@ -29,6 +32,7 @@ from transformer_tpu.serve.router import (
     Router,
 )
 from transformer_tpu.serve.scheduler import ContinuousScheduler, SlotPool
+from transformer_tpu.serve.upgrade import UpgradeCoordinator, UpgradeError
 from transformer_tpu.serve.speculative import (
     ModelDrafter,
     NgramDrafter,
@@ -49,6 +53,8 @@ __all__ = [
     "Router",
     "SlotPool",
     "TransientError",
+    "UpgradeCoordinator",
+    "UpgradeError",
     "ModelDrafter",
     "NgramDrafter",
     "drafter_from_flags",

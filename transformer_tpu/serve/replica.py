@@ -28,6 +28,15 @@ router -> replica:
                                                           PrefixCache prefixes
     {"type": "inject_state", "entries": [...]}            ...inject them into
                                                           a fresh replica
+    {"type": "upgrade", "ckpt": D, "version": V}          live-weights swap:
+                                                          verify the manifest
+                                                          + structure, stage
+                                                          into the two-version
+                                                          param slot (the flip
+                                                          lands at a drained
+                                                          step boundary)
+    {"type": "rollback"}                                  re-stage the resident
+                                                          previous weights
     {"type": "shutdown"}                                  drain + exit
 
 replica -> router:
@@ -44,6 +53,14 @@ replica -> router:
     {"type": "prefilled", "rid": N, "tokens": T, "blocks": ...}
     {"type": "prefix_state", "entries": [...]}            export_state reply
     {"type": "state_injected", "tokens": T}               inject_state reply
+    {"type": "upgrade_staged", "ok": B, "version": V
+     [, "error": E]}                                      upgrade/rollback
+                                                          verdict (ok=false =
+                                                          refused, old weights
+                                                          untouched)
+    {"type": "upgraded", "ok": B, "version": V}           the step-boundary
+                                                          flip landed (or its
+                                                          ckpt.swap abort)
     {"type": "stats", "stats": {...}}                     final, at shutdown
 
 **Router HA** (``--ha``): the worker additionally listens on a localhost
@@ -289,6 +306,16 @@ def _parse_args(argv=None) -> argparse.Namespace:
                         "for a warm standby's takeover handshake, and "
                         "survive stdin EOF (the primary dying must not "
                         "kill the fleet)")
+    p.add_argument("--init_ckpt", default="",
+                   help="bootstrap the serving weights from this "
+                        "manifest-verified checkpoint instead of the "
+                        "export/spec weights — the supervisor passes the "
+                        "fleet's TARGET version here so a respawn never "
+                        "resurrects stale weights (serve/upgrade.py)")
+    p.add_argument("--weight_version", default="",
+                   help="expected weight_version digest for --init_ckpt "
+                        "(mismatch refuses the bootstrap loudly); also "
+                        "tags an un-upgraded replica's answers")
     return p.parse_args(argv)
 
 
@@ -360,6 +387,28 @@ def main(argv=None) -> None:
         )
         tok = SubwordTokenizer.load(args.tgt_vocab_file)
 
+    weight_version = args.weight_version or None
+    if args.init_ckpt:
+        # Verified-integrity bootstrap at the fleet's target version: the
+        # checkpoint's manifest is byte-verified and its arrays matched
+        # against the spec-built tree (shape/dtype twins) BEFORE the swap
+        # — a bad artifact kills the bootstrap loudly so the supervisor's
+        # crash-loop budget (not a silently wrong fleet) absorbs it.
+        from transformer_tpu.serve.upgrade import load_checkpoint_params
+
+        params, loaded_version = load_checkpoint_params(
+            args.init_ckpt, params
+        )
+        if args.weight_version and args.weight_version != loaded_version:
+            print(
+                f"replica: --init_ckpt {args.init_ckpt} verifies to "
+                f"{loaded_version} but --weight_version expected "
+                f"{args.weight_version}; refusing to serve the wrong "
+                "weights", file=sys.stderr,
+            )
+            raise SystemExit(2)
+        weight_version = loaded_version
+
     from transformer_tpu.serve import ContinuousScheduler, PrefixCache
 
     prefix_cache = None
@@ -392,6 +441,7 @@ def main(argv=None) -> None:
         kv_layout=args.kv_layout,
         kv_block=args.prefix_block,
         kv_pool_blocks=args.kv_pool_blocks,
+        weight_version=weight_version,
         span_tap=lambda span: spans_by_order.__setitem__(
             span.get("order"), span
         ),
@@ -418,6 +468,8 @@ def main(argv=None) -> None:
     }
     if control_port is not None:
         ready["control_port"] = control_port
+    if weight_version is not None:
+        ready["weight_version"] = weight_version
     out.send(ready)
 
     hb_s = max(args.heartbeat_ms, 1.0) / 1e3
@@ -435,7 +487,32 @@ def main(argv=None) -> None:
     # always safe).
     recent_answers: "dict[int, dict]" = {}
     answer_fifo: deque = deque()
+    # At most one in-flight checkpoint verification (upgrade_staged is
+    # answered by the main loop once the loader thread finishes — the
+    # handoff is the is-alive check, so the loop never blocks on I/O).
+    upgrade_load: "list[tuple[threading.Thread, dict]]" = []
     stats_extra = {"stale_dropped": 0, "takeovers": 0, "rejected_takeovers": 0}
+
+    def _reap_upgrade_load() -> None:
+        if not upgrade_load or upgrade_load[0][0].is_alive():
+            return
+        _, holder = upgrade_load.pop(0)
+        if holder["error"] is not None:
+            out.send({
+                "type": "upgrade_staged", "ok": False,
+                "version": holder["version"], "error": holder["error"],
+            })
+            return
+        new_params, digest = holder["result"]
+        try:
+            sched.stage_params(new_params, digest)
+        except ValueError as e:
+            out.send({
+                "type": "upgrade_staged", "ok": False, "version": digest,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            return
+        out.send({"type": "upgrade_staged", "ok": True, "version": digest})
 
     def _remember(rid, msg) -> None:
         recent_answers[rid] = msg
@@ -510,6 +587,73 @@ def main(argv=None) -> None:
                             "blocks": payload,
                         })
             out.send({"type": "prefix_state", "entries": entries})
+            return True
+        if kind == "upgrade":
+            # Stage a verified weight swap (serve/upgrade.py): byte-verify
+            # the checkpoint's manifest, match it against the RUNNING
+            # params (structure/shape/dtype), confirm the coordinator's
+            # expected digest, then hand it to the scheduler's two-version
+            # slot. Verification (full npz read + per-array crc32) runs on
+            # a WORKER THREAD — a multi-GB checkpoint must not starve this
+            # loop's heartbeats, or the router's liveness sweep would fail
+            # the quiesced replica over mid-swap. The main loop collects
+            # the result (_reap_upgrade_load) and stages it; the actual
+            # flip happens at a drained step boundary — the "upgraded"
+            # message reports it. ANY failure answers a structured refusal
+            # with the old weights untouched.
+            version = msg.get("version")
+            if upgrade_load:
+                out.send({
+                    "type": "upgrade_staged", "ok": False,
+                    "version": version,
+                    "error": "an upgrade is already being verified",
+                })
+                return True
+            holder = {
+                "version": version, "result": None, "error": None,
+            }
+
+            def _load(ckpt=str(msg.get("ckpt", "")), holder=holder):
+                try:
+                    from transformer_tpu.serve.upgrade import (
+                        UpgradeError,
+                        load_checkpoint_params,
+                    )
+
+                    new_params, digest = load_checkpoint_params(
+                        ckpt, sched.params
+                    )
+                    expected = holder["version"]
+                    if expected and digest != expected:
+                        raise UpgradeError(
+                            f"checkpoint verifies to {digest} but the "
+                            f"rollout targets {expected} — wrong artifact"
+                        )
+                    holder["result"] = (new_params, digest)
+                except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — rejection IS the contract: a torn/mismatched checkpoint must become one structured refusal with serving untouched, never a dead worker
+                    holder["error"] = f"{type(e).__name__}: {e}"
+
+            t = threading.Thread(
+                target=_load, daemon=True, name="replica-upgrade-load"
+            )
+            t.start()
+            upgrade_load.append((t, holder))
+            return True
+        if kind == "rollback":
+            # Re-stage the resident previous weights (the second buffer a
+            # completed swap left behind) — the canary-rollback path.
+            try:
+                version = sched.stage_rollback()
+            except ValueError as e:
+                out.send({
+                    "type": "upgraded", "ok": False, "version": None,
+                    "error": f"{type(e).__name__}: {e}",
+                })
+                return True
+            out.send({
+                "type": "upgrade_staged", "ok": True, "version": version,
+                "rollback": True,
+            })
             return True
         if kind == "inject_state":
             total = 0
@@ -624,19 +768,29 @@ def main(argv=None) -> None:
             if not ingest(chan, msg):
                 alive = False
                 break
+        _reap_upgrade_load()
         sched.admit()
         sched.step()
         sched.idle_backoff()
         flush_answers()
+        for ev in sched.consume_swap_events():
+            # The step-boundary flip (or its ckpt.swap-injected abort)
+            # just happened: report it so the coordinator re-admits (or
+            # aborts the rollout). ``ok``/``version``/``error`` ride
+            # through verbatim.
+            out.send({"type": "upgraded", **ev})
         now = time.monotonic()
         if now - last_hb >= hb_s:
             last_hb = now
-            out.send({
+            hb = {
                 "type": "hb",
                 "backlog": sched.backlog,
                 "free": sched.num_slots - sched.active_count,
                 "active": sched.active_count,
-            })
+            }
+            if sched.weight_version is not None:
+                hb["wv"] = sched.weight_version
+            out.send(hb)
     flush_answers()
     out.send({"type": "stats", "stats": {**dict(sched.stats), **stats_extra}})
     if telemetry is not None:

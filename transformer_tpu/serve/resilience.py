@@ -81,6 +81,13 @@ FAULT_POINTS = frozenset({
     #                     heartbeat-loss/failover storms without real stalls
     "route.takeover",   # raise inside the standby's per-replica takeover
     #                     handshake — partial adoptions + split-brain drills
+    # Live-weights control plane (serve/upgrade.py, PR 15):
+    "ckpt.swap",        # raise inside the scheduler's step-boundary param
+    #                     flip — the swap aborts with old weights serving
+    "route.upgrade",    # raise inside the coordinator's per-replica swap
+    #                     dispatch — mid-rollout aborts + fleet rollback
+    "route.canary",     # mark a canary answer bad in the per-version SLO
+    #                     split — deterministic burn -> auto-rollback drills
 })
 
 
@@ -321,6 +328,9 @@ ERROR_CODES = {
     "transient": "a transient fault persisted through the bounded retries",
     "resource": "a device resource budget (paged KV pool) was exhausted "
                 "mid-flight; the partial continuation rides along",
+    "upgrade": "a live-weights rollout command was refused (torn/mismatched "
+               "checkpoint, no coordinator, or a rollout already in flight) "
+               "— serving is untouched",
     "internal": "an unexpected failure; the request was isolated",
 }
 

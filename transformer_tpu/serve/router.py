@@ -213,6 +213,13 @@ class ReplicaLink:
         self.warming = False
         self.draining = False
         self.retired = False
+        # Live-weights rollout state (serve/upgrade.py): an `upgrading`
+        # link is quiescing/swapping and takes no new dispatches (its
+        # in-flight work finishes on its admission-time weights); `wv` is
+        # the replica's last-confirmed weight_version tag (ready/hb/
+        # upgraded messages), None until the fleet is version-tagged.
+        self.upgrading = False
+        self.wv: str | None = None
         self.control_port: int | None = None  # --ha takeover socket
         self.final_stats: dict | None = None  # replica's shutdown report
 
@@ -345,6 +352,7 @@ class Router:
         telemetry=None,
         supervisor=None,
         scaler=None,
+        upgrader=None,
         slos=None,
         ha: bool = False,
         epoch: int = 1,
@@ -403,6 +411,14 @@ class Router:
         # serve/standby.py; docs/SERVING.md "Self-healing fleet") ----------
         self._sup = supervisor
         self._scaler = scaler
+        # Live-weights control plane (serve/upgrade.py): the rollout
+        # coordinator, and the fleet's TARGET weights — (ckpt_dir,
+        # weight_version) once a rollout starts/completes, None before
+        # (and after a rollback). The supervisor's spawn recipe reads it
+        # so a respawned replacement bootstraps at the version the fleet
+        # is converging to, never the stale original argv weights.
+        self._upgrader = upgrader
+        self.weight_target: "tuple[str, str] | None" = None
         self.ha = ha
         self.epoch = epoch
         self.ha_heartbeat_s = ha_heartbeat_s
@@ -465,6 +481,8 @@ class Router:
             if supervisor is None:
                 raise ValueError("a FleetScaler needs a Supervisor to act")
             scaler.bind(self, supervisor)
+        if upgrader is not None:
+            upgrader.attach(self)
 
     # ---- client intake (any thread) ---------------------------------------
 
@@ -596,6 +614,8 @@ class Router:
         if self._sup is not None:
             progressed |= self._sup.poll()
             progressed |= self._sup.reap_draining()
+        if self._upgrader is not None:
+            progressed |= self._upgrader.poll()
         slo_result = None
         if self._slo_engine is not None:
             slo_result = self._slo_engine.maybe_evaluate()
@@ -661,6 +681,18 @@ class Router:
             link.start_reader(self.inbox)
         self.on_fleet_change()
 
+    def start_upgrade(self, ckpt: str) -> dict:
+        """Begin a rolling weight swap to ``ckpt`` (the ``--upgrade`` flag
+        and the control-line command both land here). Returns the
+        coordinator's status dict; a router without an UpgradeCoordinator
+        answers a structured refusal instead of raising."""
+        if self._upgrader is None:
+            return {
+                "ok": False, "code": "upgrade",
+                "error": "this router has no UpgradeCoordinator attached",
+            }
+        return self._upgrader.start(ckpt)
+
     def reset_breaker(self, index: int) -> None:
         """A freshly admitted REPLACEMENT process deserves a fresh breaker:
         the old one's open state belongs to the dead process (an OPEN
@@ -680,6 +712,7 @@ class Router:
         return [
             l for l in self.links
             if not l.dead and not l.warming and not l.draining
+            and not l.upgrading
         ]
 
     def on_fleet_change(self) -> None:
@@ -807,6 +840,8 @@ class Router:
             link.hb_backlog = int(msg.get("backlog", 0))
             link.hb_free = int(msg.get("free", 0))
             link.hb_active = int(msg.get("active", 0))
+            if msg.get("wv") is not None:
+                link.wv = msg["wv"]
         elif kind == "prefilled":
             self._on_prefilled(link, msg)
         elif kind == "exit":
@@ -826,8 +861,18 @@ class Router:
             port = msg.get("control_port")
             if isinstance(port, int):
                 link.control_port = port
+            if msg.get("weight_version") is not None:
+                # A replica bootstrapped from --init_ckpt announces the
+                # verified version it serves — a respawn mid-rollout comes
+                # up already converged to the fleet's target.
+                link.wv = msg["weight_version"]
             if self._sup is not None and link.warming:
                 self._sup.on_ready(link)
+        elif kind in ("upgrade_staged", "upgraded"):
+            if kind == "upgraded" and msg.get("ok", True):
+                link.wv = msg.get("version")
+            if self._upgrader is not None:
+                self._upgrader.on_msg(link, msg)
         elif kind == "prefix_state":
             if self._sup is not None:
                 self._sup.on_prefix_state(link, msg)
@@ -966,6 +1011,8 @@ class Router:
         )
         if self._sup is not None:
             self._sup.on_death(link)
+        if self._upgrader is not None:
+            self._upgrader.on_death(link)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -974,10 +1021,11 @@ class Router:
         for link in self.links:
             if link.dead or not link.serves(stage):
                 continue
-            if link.warming or link.draining:
+            if link.warming or link.draining or link.upgrading:
                 # Supervision states: a warming replacement is still
                 # bootstrapping/cache-warming; a draining retiree finishes
-                # its in-flight work but takes nothing new.
+                # its in-flight work but takes nothing new; an upgrading
+                # replica is quiescing for (or mid-) a weight swap.
                 continue
             if not self.breakers[link.index].allow():
                 continue
@@ -1006,6 +1054,14 @@ class Router:
             usable = self._usable("prefill")
         if not usable:
             return None
+        if self._upgrader is not None:
+            # Canary pinning: during a rollout's canary window, a
+            # deterministic slice of accepted orders routes to the first
+            # upgraded replica so the per-version SLO split has traffic
+            # to judge (serve/upgrade.py).
+            forced = self._upgrader.route(rr, usable)
+            if forced is not None:
+                return forced, "canary"
         least = min(usable, key=lambda l: (self._load(l), l.index))
         if rr.affinity is None:
             return least, "least_loaded"
@@ -1116,6 +1172,7 @@ class Router:
                     order=rr.order, replica=link.name, policy=policy,
                     stage=rr.stage if self.disaggregate else None,
                     redispatch=rr.redispatches,
+                    weight_version=link.wv,
                     trace=rr.ctx.trace_id,
                 )
 
@@ -1127,6 +1184,10 @@ class Router:
         with self._intake_lock:
             self._done[rr.order] = resp
         self.stats["answered"] += 1
+        if self._upgrader is not None:
+            # The per-weight_version SLO split the canary verdict reads —
+            # fed from the SAME funnel as the fleet engine below.
+            self._upgrader.observe(rr, resp, slo)
         if self._slo_engine is not None:
             # The router's own SLO engine over the answer funnel: the
             # replica's per-answer side channel carries ttft/prefix numbers

@@ -113,6 +113,7 @@ import contextlib
 import dataclasses
 import threading
 import time
+import zlib
 from collections import deque
 from functools import partial
 
@@ -482,6 +483,14 @@ def _pool_copy_blocks(pool_caches, src, dst):
     return out
 
 
+def version_value(tag: "str | None") -> float:
+    """Stable numeric rendering of a weight_version tag for the
+    ``serve_weight_version`` gauge (gauges are floats; the digest is hex).
+    crc32 keeps it exactly representable in a float64 and stable across
+    processes. 0.0 = untagged."""
+    return float(zlib.crc32(str(tag).encode())) if tag else 0.0
+
+
 def _pow2_pad(ids: list[int], fill: int = 0) -> list[int]:
     """Pad an id list to the next power-of-two length (bounded compile
     set for the block-granular device ops)."""
@@ -626,6 +635,11 @@ class _Active:
     # instead of a model forward (span field; hit-rate in obs summarize).
     use_prefix: bool = False
     prefix_hit: int = 0
+    # Admission-time weight_version tag (None on an untagged scheduler):
+    # stamped at admission and carried onto the answer/span — a request
+    # that straddles an upgrade still reports (and was served by) the
+    # weights it was admitted under.
+    wv: "str | None" = None
     # Span clock (host perf_counter; None until the edge is reached):
     # enqueue -> admit -> prefill-dispatched -> first token -> finish.
     t_enqueue: float = 0.0
@@ -760,6 +774,7 @@ class ContinuousScheduler:
         kv_layout: str = "dense",
         kv_block: int = 16,
         kv_pool_blocks: int = 0,
+        weight_version: "str | None" = None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -785,6 +800,21 @@ class ContinuousScheduler:
                 "serve this config without --prefix_cache_mb"
             )
         self.params, self.cfg, self.tok = params, cfg, tokenizer
+        # ---- live-weights control plane (serve/upgrade.py) ----------------
+        # The TWO-VERSION param slot: `params` serves; a staged
+        # (params, version) pair waits for the quiesce drain; after a swap
+        # the displaced pair stays resident in `_prev` so rollback is an
+        # O(1) re-stage of buffers that never left the device. While a
+        # stage is pending, admission pauses (the local quiesce — the
+        # router has already stopped dispatching) so every in-flight
+        # request finishes on its ADMISSION-TIME weights; the flip happens
+        # at the next drained step boundary and compiles nothing: the new
+        # params are structure/shape/dtype-verified twins, so every jitted
+        # program re-runs its existing executable with new operand values.
+        self.weight_version = weight_version
+        self._staged: "tuple | None" = None        # (params, version)
+        self._prev: "tuple | None" = None          # the resident old pair
+        self._swap_events: "deque[dict]" = deque() # worker-loop outbox
         self.prefix_cache = prefix_cache
         self.prefill_chunk = prefill_chunk
         self.default_max_new = default_max_new
@@ -925,6 +955,11 @@ class ContinuousScheduler:
             self._m_slots_total = reg.gauge(
                 "serve_slots_total", "configured KV-cache slots")
             self._m_slots_total.set(num_slots)
+            self._m_weight_version = reg.gauge(
+                "serve_weight_version",
+                "crc32 of the serving weight_version tag (0 = untagged); "
+                "flips exactly at the double-buffered param swap")
+            self._m_weight_version.set(version_value(weight_version))
             self._m_active = reg.gauge(
                 "serve_slots_active", "slots occupied by in-flight requests")
             self._m_backlog = reg.gauge(
@@ -1193,6 +1228,98 @@ class ContinuousScheduler:
             self._m_pool_used.set(self.pool.alloc.used_blocks)
             self._m_pool_free.set(self.pool.alloc.free_blocks)
 
+    # ---- live weights: the two-version param slot (serve/upgrade.py) ------
+
+    def stage_params(self, params, version: str) -> None:
+        """Stage a new weight set for the double-buffered swap. The new
+        pytree must be a structural twin of the serving one — same
+        treedef, same per-leaf shapes AND dtypes — so the flip re-runs
+        every compiled program with new operand values and **zero
+        recompiles**; any mismatch raises here, before anything is
+        scheduled, and serving is untouched. While a stage is pending,
+        admission pauses (the local quiesce): every in-flight request
+        finishes on its admission-time weights, and the flip happens at
+        the next drained step boundary (:meth:`step`)."""
+        cur = jax.tree_util.tree_flatten_with_path(self.params)
+        new = jax.tree_util.tree_flatten_with_path(params)
+        if jax.tree_util.tree_structure(self.params) != (
+            jax.tree_util.tree_structure(params)
+        ):
+            raise ValueError(
+                f"staged weights for version {version!r} have a different "
+                "pytree structure than the serving params — a swap would "
+                "recompile (or crash) every program; refuse it"
+            )
+        mismatched = []
+        for (path, a), (_, b) in zip(cur[0], new[0]):
+            a_s, b_s = np.shape(a), np.shape(b)
+            a_d = getattr(a, "dtype", np.asarray(a).dtype)
+            b_d = getattr(b, "dtype", np.asarray(b).dtype)
+            if a_s != b_s or a_d != b_d:
+                key = "/".join(str(getattr(p, "key", p)) for p in path)
+                mismatched.append(f"{key}: {b_s}/{b_d} != {a_s}/{a_d}")
+        if mismatched:
+            raise ValueError(
+                f"staged weights for version {version!r} mismatch the "
+                f"serving spec on {len(mismatched)} leaf/leaves "
+                f"({'; '.join(mismatched[:3])}) — refused before any swap "
+                "was scheduled"
+            )
+        self._staged = (params, str(version))
+
+    def stage_rollback(self) -> str:
+        """Re-stage the resident PREVIOUS weights (the second buffer a
+        completed swap left behind): the canary-rollback path. Returns the
+        version being rolled back to; raises when no swap ever landed."""
+        if self._prev is None:
+            raise ValueError(
+                "no resident previous weights to roll back to (no swap has "
+                "completed on this scheduler)"
+            )
+        params, version = self._prev
+        self._staged = (params, version)
+        return version
+
+    @property
+    def swap_pending(self) -> bool:
+        return self._staged is not None
+
+    def consume_swap_events(self) -> "list[dict]":
+        """Drain completed/aborted swap notifications (the replica worker
+        forwards them to the router as ``upgraded`` messages)."""
+        out = list(self._swap_events)
+        self._swap_events.clear()
+        return out
+
+    def _maybe_swap(self) -> None:
+        """The step-boundary flip: only once the pool is DRAINED (every
+        in-flight request answered from its admission-time weights) does
+        the staged pair become the serving pair; the displaced pair stays
+        resident for O(1) rollback. The ``ckpt.swap`` fault point fires
+        here — an injected failure aborts the swap with the old weights
+        still serving and zero requests disturbed."""
+        if self._staged is None or self._active:
+            return
+        params, version = self._staged
+        self._staged = None
+        try:
+            maybe_fail("ckpt.swap")
+        except OSError as e:
+            # InjectedFault (and any real OS-level swap veto) aborts the
+            # swap, never the scheduler: old weights keep serving and the
+            # worker reports the failure upstream.
+            self._swap_events.append({
+                "ok": False, "version": version,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            return
+        self._prev = (self.params, self.weight_version)
+        self.params = params
+        self.weight_version = version
+        self._swap_events.append({"ok": True, "version": version})
+        if self._tel is not None:
+            self._m_weight_version.set(version_value(version))
+
     def submit(self, req: dict) -> int:
         now = time.perf_counter()
         # Root span BEFORE the lock (id generation is not free): parents
@@ -1381,6 +1508,13 @@ class ContinuousScheduler:
         with jittered exponential backoff before answering a structured
         "transient" error; entries whose backoff has not elapsed are
         skipped this tick, not dropped."""
+        if self._staged is not None:
+            # Quiesce: a staged weight swap is waiting for the pool to
+            # drain. New admissions would re-fill it with requests pinned
+            # to the OLD weights and starve the swap — queued requests
+            # wait (deadline/cancel sweeps still run at step boundaries)
+            # and admission resumes the moment the flip lands.
+            return
         now = time.perf_counter()
         deferred: list[_Pending] = []
         while self._free:
@@ -1586,6 +1720,8 @@ class ContinuousScheduler:
             resp["partial"] = _detokenize_rows(
                 np.asarray([st.emitted], np.int32), 1, self.tok
             )[0]
+        if st.wv is not None:
+            resp["weight_version"] = st.wv
         self._done[st.order] = resp
         if code == "deadline":
             self.stats["deadline_expired"] += 1
@@ -1604,18 +1740,18 @@ class ContinuousScheduler:
             elif code == "cancelled":
                 self._m_cancelled.inc()
             self._m_errors.inc()
-            self._record_request(
-                {
-                    "order": st.order,
-                    "prompt_tokens": st.prompt_len,
-                    "new_tokens": len(st.emitted),
-                    "queue_s": round(st.t_admit - st.t_enqueue, 6),
-                    "total_s": round(now - st.t_enqueue, 6),
-                    "error": message,
-                    "code": code,
-                },
-                root=root,
-            )
+            span = {
+                "order": st.order,
+                "prompt_tokens": st.prompt_len,
+                "new_tokens": len(st.emitted),
+                "queue_s": round(st.t_admit - st.t_enqueue, 6),
+                "total_s": round(now - st.t_enqueue, 6),
+                "error": message,
+                "code": code,
+            }
+            if st.wv is not None:
+                span["weight_version"] = st.wv
+            self._record_request(span, root=root)
 
     def _start(self, p: _Pending) -> None:
         """Admission wrapper: breaker-fault attribution (set by the inner
@@ -1826,7 +1962,7 @@ class ContinuousScheduler:
             key=np.asarray(jax.random.PRNGKey(seed)),
             sample=sample, temperature=temperature, top_k=top_k, top_p=top_p,
             seed=seed, spec=spec,
-            use_prefix=use_prefix, prefix_hit=m,
+            use_prefix=use_prefix, prefix_hit=m, wv=self.weight_version,
             dstate=(
                 self.drafter.start(ids) if spec and self.drafter is not None
                 else None
@@ -1897,6 +2033,9 @@ class ContinuousScheduler:
         speculative verify path. Retires finished slots; no-op when the
         pool is idle."""
         self._expire(time.perf_counter())
+        # The step-boundary weight flip: no-op unless a verified stage is
+        # pending AND the expiry sweep just drained the last slot.
+        self._maybe_swap()
         if self._active and self.paged:
             # Paged capacity pass BEFORE the step arrays are built: a
             # pool-exhausted slot is preempted here (answered "resource")
@@ -2293,7 +2432,10 @@ class ContinuousScheduler:
             else np.zeros((1, 0), np.int32),
             1, self.tok,
         )[0]
-        self._done[st.order] = {"continuation": text}
+        resp = {"continuation": text}
+        if st.wv is not None:
+            resp["weight_version"] = st.wv
+        self._done[st.order] = resp
         del self._active[slot]
         if self.paged:
             # After donation: table references drop, aliased prompt blocks
@@ -2330,6 +2472,8 @@ class ContinuousScheduler:
                 # Recorded on MISSES too (0): summarize's hit rate divides
                 # by prompt_tokens over participating requests only.
                 span["prefix_hit_tokens"] = st.prefix_hit
+            if st.wv is not None:
+                span["weight_version"] = st.wv
             if st.t_prefill is not None:
                 span["prefill_s"] = round(st.t_prefill - st.t_admit, 6)
             if st.t_first is not None:

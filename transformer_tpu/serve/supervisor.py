@@ -50,6 +50,7 @@ the router, the supervision tier is model-free host code.
 
 from __future__ import annotations
 
+import inspect
 import time
 
 from transformer_tpu.serve.resilience import maybe_fail
@@ -103,6 +104,18 @@ class Supervisor:
         clock=time.monotonic,
     ):
         self._spawn = spawn
+        # Live-weights fix (serve/upgrade.py): a respawn must bootstrap at
+        # the fleet's CURRENT target version (Router.weight_target), not
+        # the original argv checkpoint — otherwise a heal after a rollout
+        # silently resurrects stale weights. Recipes that accept a 4th
+        # parameter get the (ckpt_dir, weight_version) target; 3-arg
+        # recipes (pre-upgrade fakes and callers) keep working unchanged.
+        try:
+            self._spawn_takes_target = (
+                len(inspect.signature(spawn).parameters) >= 4
+            )
+        except (TypeError, ValueError):
+            self._spawn_takes_target = False
         self.max_restarts = max(1, max_restarts)
         self.restart_window_s = restart_window_s
         self.backoff_ms = backoff_ms
@@ -163,6 +176,17 @@ class Supervisor:
                 delay, self._router.breakers[link.index].cooldown_s
             )
         slot.next_try = now + delay
+
+    def _bootstrap(self, index: int, name: str, role: str):
+        """One (re)spawn through the deterministic recipe — at the
+        fleet's TARGET weight version when a rollout set one, so a
+        replacement never serves weights the fleet has moved past."""
+        if self._spawn_takes_target:
+            return self._spawn(
+                index, name, role,
+                getattr(self._router, "weight_target", None),
+            )
+        return self._spawn(index, name, role)
 
     def _backoff_s(self, attempts: int) -> float:
         return min(
@@ -234,7 +258,7 @@ class Supervisor:
         self.stats["spawn_attempts"] += 1
         try:
             maybe_fail("route.spawn")
-            new_link = self._spawn(slot.index, slot.name, slot.role)
+            new_link = self._bootstrap(slot.index, slot.name, slot.role)
         except Exception:  # noqa: BLE001 — every spawn failure (injected or real: fork limits, a corrupt model spec) is one budgeted attempt, never a crash of the router  # tpa: disable=TPA006
             self._count_failure(slot, now)
             if slot.phase != "gave_up":
@@ -341,7 +365,7 @@ class Supervisor:
         self.stats["spawn_attempts"] += 1
         try:
             maybe_fail("route.spawn")
-            link = self._spawn(index, name, role)
+            link = self._bootstrap(index, name, role)
         except Exception:  # noqa: BLE001 — a failed scale-up is a skipped decision, not a router crash  # tpa: disable=TPA006
             self.stats["spawn_failures"] += 1
             return False

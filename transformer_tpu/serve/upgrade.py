@@ -1,0 +1,696 @@
+"""Live-weights control plane: zero-downtime rolling checkpoint swaps.
+
+Production serving cannot restart to pick up a new checkpoint — and after
+PR 11 a restart is exactly what a respawn IS, so an out-of-band weight
+change would be silently reverted by the next heal. This module makes
+weight rollout a first-class *control-plane* operation over the PR 10/11
+fleet (docs/SERVING.md "Live-weights rollout"):
+
+- **Verified integrity at the door**: :func:`verify_checkpoint` checks the
+  target checkpoint's per-array crc32 manifest (``train/checkpoint.py``)
+  BEFORE any replica is touched — a torn, bit-rotted, or mixed checkpoint
+  is rejected fleet-wide with a structured ``upgrade`` error and zero
+  impact on serving. The manifest digest doubles as the rollout's
+  ``weight_version`` tag. Replicas re-verify (and structure-check against
+  their RUNNING params) before anything is staged —
+  :func:`load_checkpoint_params`.
+- **Rolling, router-coordinated swap** (:class:`UpgradeCoordinator`, owned
+  by the router thread like the Supervisor): one replica at a time is
+  *quiesced* through the existing dispatch policy (``link.upgrading`` —
+  no new dispatches; in-flight requests finish on their admission-time
+  weights), told to stage the verified params (the scheduler's two-version
+  param slot flips at a drained step boundary with **zero recompiles** —
+  the staged tree is a structure/shape/dtype twin), then re-admitted.
+- **Canary gating**: the FIRST upgraded replica is the canary. The router
+  pins a deterministic traffic slice to it (every ``canary_every``-th
+  accepted order), and a per-``weight_version`` split of the PR 9
+  :class:`~transformer_tpu.obs.slo.SLOEngine` evaluates the canary's burn
+  (availability / ttft_p95 over short windows). Sustained burn > 1 rolls
+  the canary BACK — the old params are still the resident second buffer,
+  so rollback is an O(1) re-stage — and the rollout ends with
+  ``route.upgrade rolled_back=true`` carrying the burn evidence. A clean
+  window promotes the rollout to the rest of the fleet.
+- **Respawn at the fleet's target version**: a successful rollout sets
+  ``Router.weight_target``; the supervisor's spawn recipe appends
+  ``--init_ckpt``/``--weight_version`` so a replica killed mid- or
+  post-rollout is re-bootstrapped at the version the fleet is CONVERGING
+  TO, not the argv checkpoint it was originally launched with (the
+  stale-respawn bug this PR fixes). A rollback clears the target.
+
+Fault plane (docs/ROBUSTNESS.md): ``route.upgrade`` fires inside the
+coordinator's per-replica swap dispatch (an injected fault aborts the
+rollout and rolls upgraded replicas back), ``route.canary`` marks canary
+answers bad (deterministic burn → rollback drills), and ``ckpt.swap``
+fires inside the scheduler's step-boundary flip (the swap aborts with the
+old weights still serving).
+
+Threading contract (TPA101-105): every method runs on the ROUTER thread
+(``Router.pump`` drives :meth:`UpgradeCoordinator.poll`; ``observe``/
+``on_msg``/``on_death`` are called from the router's inbox drain and
+answer funnel). The checkpoint helpers at the top are host-side
+numpy/stdlib; :func:`load_checkpoint_params` (replica side) is the only
+function that touches jax, and only lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from transformer_tpu.serve.resilience import fired, maybe_fail
+
+#: ``route_upgrade_state`` gauge values (obs; docs/OBSERVABILITY.md).
+UPGRADE_STATE_VALUE = {
+    "idle": 0, "quiesce": 1, "swap": 2, "canary": 3, "rolling": 4,
+    "rolling_back": 5, "rolled_back": 6, "done": 7, "failed": 8,
+}
+
+
+class UpgradeError(RuntimeError):
+    """A checkpoint failed verification or structure-matching — the
+    rollout (or replica load) refuses it before any swap is scheduled."""
+
+
+def resolve_checkpoint_dir(path: str) -> str:
+    """Accept either one checkpoint directory (holding ``arrays.npz``) or
+    a CheckpointManager directory (pick the newest ``ckpt_*`` step)."""
+    if os.path.exists(os.path.join(path, "arrays.npz")):
+        return path
+    if os.path.isdir(path):
+        import re
+
+        steps = sorted(
+            name for name in os.listdir(path)
+            if re.fullmatch(r"ckpt_\d{8}", name)
+        )
+        if steps:
+            return os.path.join(path, steps[-1])
+    raise UpgradeError(
+        f"no checkpoint at {path!r}: expected arrays.npz or ckpt_* steps"
+    )
+
+
+def verify_checkpoint(path: str) -> "tuple[str, str]":
+    """Fleet-wide admission check for an upgrade target: resolve the
+    checkpoint dir and byte-verify it against its manifest. Returns
+    ``(ckpt_dir, weight_version digest)``; raises :class:`UpgradeError`
+    with the integrity failure (torn manifest, crc mismatch, missing
+    manifest — an unmanifested checkpoint cannot prove byte-consistency
+    across N replicas, so the control plane refuses it)."""
+    ckpt_dir = resolve_checkpoint_dir(path)
+    from transformer_tpu.train.checkpoint import verify_manifest
+
+    try:
+        return ckpt_dir, verify_manifest(ckpt_dir)
+    except UpgradeError:
+        raise
+    except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — admission check: EVERY failure shape (torn manifest, truncated npz, missing file, crc mismatch) must become one structured refusal with serving untouched, never a router crash
+        raise UpgradeError(
+            f"checkpoint at {ckpt_dir} failed integrity verification: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+
+
+def load_checkpoint_params(path: str, template) -> "tuple[object, str]":
+    """Replica-side verified load: byte-verify the checkpoint, then check
+    its arrays against the RUNNING param tree — same key set, same
+    per-leaf shapes AND dtypes (a swap must re-run the compiled programs,
+    so nothing may differ but values). Returns ``(params, digest)`` with
+    the params rebuilt in ``template``'s tree structure; raises
+    :class:`UpgradeError` on any mismatch, before anything is staged."""
+    import jax
+    import numpy as np
+
+    from transformer_tpu.train.checkpoint import (
+        _SEP,
+        _path_elem,
+        verify_manifest,
+    )
+
+    ckpt_dir = resolve_checkpoint_dir(path)
+    with np.load(os.path.join(ckpt_dir, "arrays.npz")) as data:
+        flat = {k: data[k] for k in data.files}
+    try:
+        version = verify_manifest(ckpt_dir, flat)
+    except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — same admission-check contract as verify_checkpoint: one structured refusal, serving untouched
+        raise UpgradeError(
+            f"checkpoint at {ckpt_dir} failed integrity verification: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    problems = []
+    for p, leaf in leaves_with_path:
+        key = _SEP.join(_path_elem(e) for e in p)
+        if key not in flat:
+            problems.append(f"missing {key!r}")
+            continue
+        arr = flat[key]
+        ref = np.asarray(leaf)
+        if arr.shape != ref.shape or arr.dtype != ref.dtype:
+            problems.append(
+                f"{key}: checkpoint {arr.shape}/{arr.dtype} != running "
+                f"{ref.shape}/{ref.dtype}"
+            )
+            continue
+        new_leaves.append(arr)
+    extra = sorted(set(flat) - {
+        _SEP.join(_path_elem(e) for e in p) for p, _ in leaves_with_path
+    })
+    if extra:
+        problems.append(f"{len(extra)} extra array(s), e.g. {extra[0]!r}")
+    if problems:
+        raise UpgradeError(
+            f"checkpoint {version} at {ckpt_dir} does not match the running "
+            f"model spec ({'; '.join(problems[:3])}) — swap refused"
+        )
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), version
+
+
+def _default_canary_slos():
+    """Short-window availability + TTFT objectives for the canary verdict
+    — deliberately tighter windows than the serving defaults (a canary
+    window is seconds, not hours)."""
+    from transformer_tpu.obs.slo import SLOSpec
+
+    return (
+        SLOSpec("availability", "availability", 0.99, windows=(5.0, 30.0)),
+        SLOSpec(
+            "ttft_p95", "ttft_p95", 0.95, threshold_s=2.0,
+            windows=(5.0, 30.0),
+        ),
+    )
+
+
+class UpgradeCoordinator:
+    """Router-thread rollout state machine (see the module docstring).
+
+    ``verify`` (injectable for the deterministic-schedule scenario and
+    fakes) maps an upgrade path to ``(ckpt_dir, weight_version)`` —
+    default :func:`verify_checkpoint`. ``canary_slos`` is an
+    ``--slo_spec``-grammar string or a spec tuple for the per-version
+    burn split; ``canary_every`` pins every N-th accepted order to the
+    canary (0 = the fleet size at rollout start, so the canary keeps its
+    fair deterministic share)."""
+
+    def __init__(
+        self,
+        *,
+        canary_window_s: float = 5.0,
+        canary_min_requests: int = 4,
+        canary_every: int = 0,
+        canary_slos=None,
+        quiesce_timeout_s: float = 60.0,
+        swap_timeout_s: float = 60.0,
+        verify=None,
+        clock=time.monotonic,
+    ):
+        self.canary_window_s = canary_window_s
+        self.canary_min_requests = max(1, canary_min_requests)
+        self._canary_every_cfg = canary_every
+        if canary_slos is None:
+            self._canary_specs = _default_canary_slos()
+        elif isinstance(canary_slos, str):
+            from transformer_tpu.obs.slo import parse_slo_spec
+
+            self._canary_specs = parse_slo_spec(canary_slos)
+        else:
+            self._canary_specs = tuple(canary_slos)
+        self.quiesce_timeout_s = quiesce_timeout_s
+        self.swap_timeout_s = swap_timeout_s
+        self._verify = verify if verify is not None else verify_checkpoint
+        self._clock = clock
+        self._router = None
+        self.state = "idle"
+        # Rollout-scoped state (reset by start()).
+        self._ckpt: str | None = None
+        self.target_version: str | None = None
+        self._queue: list[int] = []          # replica indices still to do
+        self._current: int | None = None     # index being quiesced/swapped
+        self._phase_t0 = 0.0
+        self._quiesce_t0 = 0.0
+        self._started_at = 0.0
+        self._canary: int | None = None
+        self._canary_every = 2
+        self._canary_t0 = 0.0
+        self._canary_seen = 0
+        self._promoted = False
+        self._rolling_back: set[int] = set()
+        self._rollback_reason: str | None = None
+        self._engines: dict = {}             # weight_version -> SLOEngine
+        self.stats = {
+            "started": 0, "completed": 0, "rejected": 0, "rollbacks": 0,
+            "aborted": 0, "replicas_upgraded": 0, "canary_requests": 0,
+            "injected_canary_burn": 0,
+        }
+
+    # -- wiring (router thread) ---------------------------------------------
+
+    def attach(self, router) -> None:
+        self._router = router
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        router = self._router
+        if router is not None and router._tel is not None:
+            router._tel.registry.gauge(
+                "route_upgrade_state",
+                "rollout state: 0 idle, 1 quiesce, 2 swap, 3 canary, "
+                "4 rolling, 5 rolling_back, 6 rolled_back, 7 done, 8 failed",
+            ).set(UPGRADE_STATE_VALUE[state])
+
+    def _emit(self, kind: str, **fields) -> None:
+        self._router.emit_event(kind, **fields)
+
+    # -- rollout admission ----------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self.state in (
+            "quiesce", "swap", "canary", "rolling", "rolling_back"
+        )
+
+    def start(self, path: str) -> dict:
+        """Begin a rollout to the checkpoint at ``path``. Integrity is
+        enforced HERE, fleet-wide, before any replica is touched: a torn
+        or mismatched checkpoint answers a structured ``upgrade`` refusal
+        and serving is not disturbed. Returns a status dict (the control
+        line answers it verbatim)."""
+        if self._router is None:
+            return {"ok": False, "code": "upgrade",
+                    "error": "no router attached"}
+        if self.active:
+            return {
+                "ok": False, "code": "upgrade",
+                "error": f"a rollout to {self.target_version} is already "
+                         f"in flight (state {self.state})",
+            }
+        try:
+            ckpt_dir, version = self._verify(path)
+        except Exception as e:  # noqa: BLE001  # tpa: disable=TPA006 — rejection IS the feature: any verification failure becomes one structured refusal event with zero serving impact
+            self.stats["rejected"] += 1
+            self._emit(
+                "route.upgrade", phase="rejected", ckpt=path,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return {"ok": False, "code": "upgrade",
+                    "error": f"{type(e).__name__}: {e}"}
+        roster = [
+            l.index for l in self._router.links
+            if not l.dead and not l.retired and l.wv != version
+        ]
+        if not roster:
+            self.stats["rejected"] += 1
+            self._emit(
+                "route.upgrade", phase="rejected", ckpt=ckpt_dir,
+                version=version,
+                error="no live replica needs this version",
+            )
+            return {"ok": False, "code": "upgrade", "version": version,
+                    "error": "no live replica needs this version"}
+        self._ckpt = ckpt_dir
+        self.target_version = version
+        self._queue = roster
+        self._current = None
+        self._canary = None
+        self._canary_seen = 0
+        self._promoted = False
+        self._rolling_back = set()
+        self._rollback_reason = None
+        self._engines = {}
+        # The documented default slice: 1/fleet-size (the canary's fair
+        # share of the LIVE fleet, not of the not-yet-converged roster).
+        fleet = sum(
+            1 for l in self._router.links if not l.dead and not l.retired
+        )
+        self._canary_every = self._canary_every_cfg or max(2, fleet)
+        self._started_at = self._clock()
+        self.stats["started"] += 1
+        # Respawns from here on come up at the TARGET version: a replica
+        # SIGKILLed mid-rollout must not resurrect the stale argv weights.
+        self._router.weight_target = (ckpt_dir, version)
+        self._set_state("quiesce")
+        self._emit(
+            "route.upgrade", phase="started", ckpt=ckpt_dir,
+            version=version, canary_every=self._canary_every,
+            replicas=[self._router.links[i].name for i in roster],
+        )
+        return {"ok": True, "version": version, "replicas": len(roster)}
+
+    # -- the poll loop (router thread, from Router.pump) ----------------------
+
+    def poll(self) -> bool:
+        if self._router is None or not self.active:
+            return False
+        now = self._clock()
+        if self.state == "rolling_back":
+            return self._poll_rollback(now)
+        if self.state == "canary":
+            return self._poll_canary(now)
+        # quiesce / swap / rolling: drive the current replica forward.
+        if self._current is None:
+            return self._pick_next(now)
+        link = self._router.links[self._current]
+        if link.dead:
+            # Mid-swap death: failover already re-queued its work; the
+            # supervisor respawns it AT THE TARGET VERSION (weight_target
+            # is set), so this index needs no further coordination —
+            # continue the rollout with the rest.
+            link.upgrading = False
+            self._current = None
+            return True
+        if self.state == "quiesce":
+            if link.inflight == 0:
+                return self._send_swap(link, now)
+            if now - self._quiesce_t0 > self.quiesce_timeout_s:
+                self._abort(
+                    f"replica {link.name} did not drain within "
+                    f"{self.quiesce_timeout_s:g}s"
+                )
+                return True
+            return False
+        if self.state == "swap" and now - self._phase_t0 > self.swap_timeout_s:
+            self._abort(
+                f"replica {link.name} did not confirm the swap within "
+                f"{self.swap_timeout_s:g}s"
+            )
+            return True
+        return False
+
+    def _pick_next(self, now: float) -> bool:
+        while self._queue:
+            index = self._queue.pop(0)
+            link = self._router.links[index]
+            if link.dead or link.retired or link.wv == self.target_version:
+                # Dead replicas respawn at the target; already-converged
+                # ones (a respawn that beat us here) need nothing.
+                continue
+            self._current = index
+            link.upgrading = True
+            self._quiesce_t0 = now
+            self._set_state("quiesce")
+            return True
+        self._complete(now)
+        return True
+
+    def _send_swap(self, link, now: float) -> bool:
+        try:
+            # route.upgrade fault point: a deterministically injected
+            # dispatch failure aborts the rollout (and rolls upgraded
+            # replicas back) — the mid-rollout-abort drill.
+            maybe_fail("route.upgrade")
+            link.send({
+                "type": "upgrade", "ckpt": self._ckpt,
+                "version": self.target_version,
+            })
+        except (OSError, ValueError) as e:
+            self._abort(
+                f"upgrade dispatch to {link.name} failed: "
+                f"{type(e).__name__}: {e}"
+            )
+            return True
+        self._phase_t0 = now
+        self._set_state("swap")
+        return True
+
+    def _complete(self, now: float) -> None:
+        self._current = None
+        self.stats["completed"] += 1
+        self._set_state("done")
+        # weight_target STAYS set: future respawns and scale-ups bootstrap
+        # at the fleet's converged version (the stale-respawn fix).
+        self._emit(
+            "route.upgrade", phase="completed",
+            version=self.target_version,
+            time_to_upgrade_s=round(now - self._started_at, 6),
+            replicas_upgraded=self.stats["replicas_upgraded"],
+        )
+
+    # -- canary ---------------------------------------------------------------
+
+    def _canary_engine(self, version: str):
+        if version not in self._engines:
+            from transformer_tpu.obs.slo import SLOEngine
+
+            self._engines[version] = SLOEngine(
+                self._canary_specs, interval=0.0, clock=time.time,
+            )
+        return self._engines[version]
+
+    def _poll_canary(self, now: float) -> bool:
+        link = self._router.links[self._canary]
+        if link.dead:
+            # A dead canary is NOT a clean window. Its replacement
+            # (respawning at the target version) inherits the slice and
+            # on_death restarted the window — but a canary that STAYS
+            # dead (the new weights crash it, the respawn budget
+            # exhausts) must read as a rollback signal, never as
+            # traffic-starved promotion: burn stays 0 exactly because
+            # failovers answered on old-version survivors.
+            if now - self._canary_t0 >= 4 * max(self.canary_window_s, 0.5):
+                self._begin_rollback(
+                    "canary replica died on the new weights and did not "
+                    "recover"
+                )
+                return True
+            return False
+        result = self._canary_engine(self.target_version).maybe_evaluate()
+        breached = [
+            name for name, r in (result or {}).items() if r["breached"]
+        ]
+        if breached:
+            evidence = {
+                name: {
+                    k: w["burn_rate"]
+                    for k, w in (result or {})[name]["windows"].items()
+                }
+                for name in breached
+            }
+            self._begin_rollback(
+                f"canary burn > 1 sustained on {'+'.join(breached)}",
+                evidence=evidence,
+            )
+            return True
+        elapsed = now - self._canary_t0
+        if elapsed >= self.canary_window_s and (
+            self._canary_seen >= self.canary_min_requests
+            or elapsed >= 4 * self.canary_window_s
+        ):
+            # Clean window: promote the rollout to the rest of the fleet.
+            # (4x the window with too-little traffic promotes too — an
+            # idle fleet must not wedge its own upgrade forever.)
+            self._promoted = True
+            self._emit(
+                "route.canary", phase="promoted",
+                replica=self._router.links[self._canary].name,
+                version=self.target_version,
+                window_s=round(elapsed, 3), requests=self._canary_seen,
+            )
+            self._set_state("rolling")
+            return True
+        return False
+
+    def route(self, rr, usable):
+        """The router's canary pin: during the canary window, every
+        ``canary_every``-th accepted order routes to the canary (when it
+        can serve the stage) — a deterministic slice, so the drill and
+        the share number replay exactly."""
+        if self.state != "canary" or self._canary is None:
+            return None
+        if rr.order % self._canary_every != 0:
+            return None
+        link = self._router.links[self._canary]
+        return link if link in usable else None
+
+    def observe(self, rr, resp: dict, slo) -> None:
+        """Answer-funnel tap (router thread): split every tagged answer
+        into its weight_version's SLO engine — the per-version burn the
+        canary verdict reads. The ``route.canary`` fault point marks
+        canary answers bad here, so burn-triggered rollback is a
+        deterministic ``--fault_spec`` drill."""
+        if not self.active or self.target_version is None:
+            return
+        version = resp.get("weight_version")
+        if version is None:
+            return
+        sample = dict(slo) if isinstance(slo, dict) else {}
+        sample.setdefault("total_s", 0.0)
+        if "error" in resp:
+            sample["error"] = resp["error"]
+            if "code" in resp:
+                sample["code"] = resp["code"]
+        if version == self.target_version:
+            if self.state == "canary":
+                self._canary_seen += 1
+                self.stats["canary_requests"] += 1
+            if fired("route.canary"):
+                # Injected canary burn: the sample is recorded as an
+                # availability failure (and a TTFT bust when it carried a
+                # latency), so the rollback ladder drills end-to-end.
+                self.stats["injected_canary_burn"] += 1
+                sample["error"] = "injected canary burn (route.canary)"
+                sample["ttft_s"] = 1e9
+        self._canary_engine(version).record(sample)
+
+    # -- replica messages (router inbox, router thread) ----------------------
+
+    def on_msg(self, link, msg: dict) -> None:
+        kind = msg.get("type")
+        if kind == "upgrade_staged":
+            if not msg.get("ok"):
+                # The replica refused the checkpoint (digest/structure
+                # mismatch, torn file): reject fleet-wide.
+                self._abort(
+                    f"replica {link.name} refused the checkpoint: "
+                    f"{msg.get('error')}"
+                )
+            return
+        if kind != "upgraded":
+            return
+        version = msg.get("version")
+        if not msg.get("ok", True):
+            if self._rolling_back or self.state == "rolling_back":
+                # A rollback swap failing (ckpt.swap firing twice) leaves
+                # the replica on the NEW weights; note it and move on —
+                # the operator sees the failed state and the versions.
+                self._rolling_back.discard(link.index)
+                return
+            if not self.active:
+                return  # a stale abort outside any rollout
+            self._abort(
+                f"replica {link.name} swap aborted: {msg.get('error')}"
+            )
+            return
+        link.wv = version
+        if self._rolling_back or self.state in (
+            "rolling_back", "rolled_back", "failed"
+        ):
+            if (
+                version == self.target_version
+                and self.target_version is not None
+                and not link.dead
+            ):
+                # The quiesced swap landed AFTER the rollback decision
+                # (a late confirmation that raced the abort): converge
+                # this replica back too — a half-upgraded fleet is the
+                # one state the control plane must never leave behind.
+                try:
+                    link.upgrading = True
+                    link.send({"type": "rollback"})
+                    self._rolling_back.add(link.index)
+                    if self.state in ("rolled_back", "failed"):
+                        self._set_state("rolling_back")
+                except (OSError, ValueError):
+                    link.upgrading = False  # failover handles it
+            else:
+                self._rolling_back.discard(link.index)
+                link.upgrading = False
+            return
+        if not self.active or version != self.target_version:
+            return  # a stale/rollback confirmation outside a rollout
+        link.upgrading = False
+        self.stats["replicas_upgraded"] += 1
+        now = self._clock()
+        self._emit(
+            "route.upgrade", phase="swapped", replica=link.name,
+            version=version,
+            quiesce_s=round(self._phase_t0 - self._quiesce_t0, 6),
+            swap_s=round(now - self._phase_t0, 6),
+        )
+        if self._current == link.index:
+            self._current = None
+        if self._canary is None and not self._promoted:
+            # First upgraded replica = the canary: pin its slice, start
+            # the window, and HOLD the rollout until the verdict.
+            self._canary = link.index
+            self._canary_t0 = now
+            self._canary_seen = 0
+            self._set_state("canary")
+            self._emit(
+                "route.canary", phase="started", replica=link.name,
+                version=version, every=self._canary_every,
+                window_s=self.canary_window_s,
+            )
+        else:
+            self._set_state("rolling")
+
+    def on_death(self, link) -> None:
+        """Router failover notification: a mid-rollout death needs no
+        special handling beyond un-pinning — the supervisor respawns the
+        index at ``weight_target``, and the roster skip in
+        ``_pick_next``/``poll`` treats the replacement as converged."""
+        if not self.active:
+            return
+        link.upgrading = False
+        if self._current == link.index:
+            self._current = None
+        if self.state == "canary" and self._canary == link.index:
+            # The canary died mid-window: its REPLACEMENT (same index,
+            # target version) inherits the slice; restart the window so
+            # the verdict covers only replacement traffic.
+            self._canary_t0 = self._clock()
+            self._canary_seen = 0
+        if link.index in self._rolling_back:
+            self._rolling_back.discard(link.index)
+
+    # -- rollback / abort -----------------------------------------------------
+
+    def _begin_rollback(self, reason: str, evidence=None) -> None:
+        """Swap every already-upgraded replica BACK to the resident old
+        params (they are still the second buffer — an O(1) re-stage) and
+        surrender the rollout. The canary-burn path and the mid-rollout
+        abort path both land here."""
+        self.stats["rollbacks"] += 1
+        self._rollback_reason = reason
+        self._rolling_back = set()
+        self._queue = []  # a surrendered rollout must never resume
+        router = self._router
+        router.weight_target = None  # respawns revert to argv weights
+        for link in router.links:
+            if link.dead or link.retired:
+                continue
+            if link.wv == self.target_version:
+                link.upgrading = True  # quiesce for the rollback swap too
+                try:
+                    link.send({"type": "rollback"})
+                    self._rolling_back.add(link.index)
+                except (OSError, ValueError):
+                    link.upgrading = False  # failover will handle it
+            else:
+                link.upgrading = False
+        self._current = None
+        self._set_state("rolling_back")
+        self._emit(
+            "route.upgrade", phase="rolled_back", rolled_back=True,
+            version=self.target_version, reason=reason,
+            evidence=evidence,
+            replicas=[
+                router.links[i].name for i in sorted(self._rolling_back)
+            ],
+        )
+
+    def _poll_rollback(self, now: float) -> bool:
+        self._rolling_back = {
+            i for i in self._rolling_back
+            if not self._router.links[i].dead
+            and self._router.links[i].wv == self.target_version
+        }
+        if self._rolling_back:
+            return False
+        self._set_state(
+            "rolled_back" if self._rollback_reason else "failed"
+        )
+        return True
+
+    def _abort(self, reason: str) -> None:
+        """A structural failure (refused checkpoint, swap fault, dispatch
+        failure, drain timeout): emit the evidence and converge the fleet
+        BACK to the old version — a half-upgraded fleet is the one state
+        the control plane must never leave behind."""
+        self.stats["aborted"] += 1
+        self._emit(
+            "route.upgrade", phase="failed", version=self.target_version,
+            error=reason,
+        )
+        self._begin_rollback(reason)
+        self._rollback_reason = None  # final state "failed", not rolled_back

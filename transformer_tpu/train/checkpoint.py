@@ -11,6 +11,11 @@ Two on-disk layouts, auto-selected per save:
     <dir>/ckpt_<step>/
         arrays.npz      flattened {path: array} of the state pytree
         meta.json       step + key list
+        manifest.json   per-array crc32 + shape/dtype and a digest over the
+                        entry table (the checkpoint's weight_version tag);
+                        written atomically (tmp + fsync + rename) and
+                        byte-verified by restore_latest before any
+                        structural probe runs
 
 *Sharded* (any leaf distributed over >1 device): no full array is ever
 materialized on any host — the thing that makes >HBM models checkpointable
@@ -33,12 +38,14 @@ save never leaves a corrupt "latest".
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
 import shutil
 import sys
 import zipfile
+import zlib
 from typing import Any
 
 import jax
@@ -62,6 +69,146 @@ _CORRUPT_CHECKPOINT_ERRORS = (
 )
 
 _SEP = "/"
+
+#: Per-checkpoint integrity manifest (replicated format): one entry per
+#: stored array (crc32 over the raw bytes + shape + dtype) plus a digest
+#: over the sorted entry table. The digest doubles as the checkpoint's
+#: ``weight_version`` tag in the live-weights control plane
+#: (``serve/upgrade.py``): byte-identical weights => identical digest, so
+#: mixed-version-fleet byte-consistency is assertable per tag.
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointIntegrityError(ValueError):
+    """The checkpoint's bytes disagree with its manifest (torn write, bit
+    rot, a mixed copy) — or the manifest itself is torn. Subclasses
+    ``ValueError`` so ``restore_latest``'s corrupt-checkpoint fallback
+    treats it exactly like the structural probe it supersedes."""
+
+
+def manifest_entries(flat: "dict[str, np.ndarray]") -> dict:
+    """Per-array integrity entries for a flattened checkpoint: crc32 over
+    the raw array bytes (layout-normalized), shape, dtype. Pure numpy —
+    the model-free router verifies checkpoints with this too."""
+    out = {}
+    for key in sorted(flat):
+        a = np.ascontiguousarray(flat[key])
+        out[key] = {
+            "crc32": zlib.crc32(a.tobytes()) & 0xFFFFFFFF,
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+        }
+    return out
+
+
+def manifest_digest(entries: dict) -> str:
+    """Digest over the canonicalized entry table — the checkpoint's
+    ``weight_version``. Any flipped byte, reshaped leaf, or re-dtyped leaf
+    changes it; a byte-identical save reproduces it."""
+    blob = json.dumps(entries, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def build_manifest(flat: "dict[str, np.ndarray]", step: "int | None") -> dict:
+    entries = manifest_entries(flat)
+    return {
+        "format": "manifest-v1",
+        "step": step,
+        "arrays": entries,
+        "digest": manifest_digest(entries),
+    }
+
+
+def write_manifest(
+    dirpath: str, flat: "dict[str, np.ndarray]", step: "int | None" = None
+) -> dict:
+    """Commit ``dirpath``'s integrity manifest atomically: tmp file,
+    fsync, rename — a crash mid-write leaves either no manifest (the
+    pre-manifest structural probe still applies) or a complete one, never
+    a torn one that could reject a good checkpoint."""
+    manifest = build_manifest(flat, step)
+    final = os.path.join(dirpath, MANIFEST_NAME)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    return manifest
+
+
+def load_manifest(ckpt_dir: str) -> "dict | None":
+    """The checkpoint's manifest, or None when it predates manifests.
+    A torn/garbled manifest raises :class:`CheckpointIntegrityError`
+    (json's ValueError is re-shaped so callers see one corruption type)."""
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except ValueError as e:
+        raise CheckpointIntegrityError(
+            f"manifest at {ckpt_dir} is unparseable: {e}"
+        ) from e
+    if not isinstance(manifest, dict) or not isinstance(
+        manifest.get("arrays"), dict
+    ) or "digest" not in manifest:
+        raise CheckpointIntegrityError(
+            f"manifest at {ckpt_dir} is missing its arrays/digest fields"
+        )
+    return manifest
+
+
+def verify_manifest(
+    ckpt_dir: str, flat: "dict[str, np.ndarray] | None" = None
+) -> str:
+    """Verify ``ckpt_dir``'s stored arrays against its manifest: internal
+    digest consistency, key set, then per-array shape/dtype/crc32. Returns
+    the verified digest (the ``weight_version``); raises
+    :class:`CheckpointIntegrityError` on ANY disagreement and
+    ``FileNotFoundError``/``zipfile`` errors on unreadable files. ``flat``
+    skips the npz read when the caller already loaded the arrays (the
+    replica verifies and loads in one pass)."""
+    manifest = load_manifest(ckpt_dir)
+    if manifest is None:
+        raise CheckpointIntegrityError(f"no manifest at {ckpt_dir}")
+    entries = manifest["arrays"]
+    if manifest_digest(entries) != manifest["digest"]:
+        raise CheckpointIntegrityError(
+            f"manifest at {ckpt_dir} fails its own digest (torn manifest)"
+        )
+    if flat is None:
+        with np.load(os.path.join(ckpt_dir, "arrays.npz")) as data:
+            flat = {k: data[k] for k in data.files}
+    if sorted(flat) != sorted(entries):
+        missing = sorted(set(entries) - set(flat))
+        extra = sorted(set(flat) - set(entries))
+        raise CheckpointIntegrityError(
+            f"checkpoint at {ckpt_dir} disagrees with its manifest key set "
+            f"(missing {missing[:3]}, extra {extra[:3]})"
+        )
+    for key, e in entries.items():
+        a = np.ascontiguousarray(flat[key])
+        if list(a.shape) != e["shape"] or str(a.dtype) != e["dtype"]:
+            raise CheckpointIntegrityError(
+                f"{key}: stored {a.shape}/{a.dtype} but the manifest "
+                f"records {tuple(e['shape'])}/{e['dtype']}"
+            )
+        if (zlib.crc32(a.tobytes()) & 0xFFFFFFFF) != e["crc32"]:
+            raise CheckpointIntegrityError(
+                f"{key}: stored bytes fail the manifest crc32 — the "
+                "checkpoint is torn or bit-rotted"
+            )
+    return manifest["digest"]
+
+
+def checkpoint_version(ckpt_dir: str) -> "str | None":
+    """The checkpoint's ``weight_version`` tag (manifest digest) WITHOUT
+    byte verification — the cheap read for tagging/telemetry. None when
+    the checkpoint predates manifests."""
+    manifest = load_manifest(ckpt_dir)
+    return None if manifest is None else manifest["digest"]
 
 
 def _flatten(tree: Any) -> dict[str, np.ndarray]:
@@ -151,6 +298,10 @@ class CheckpointManager:
         np.savez(os.path.join(tmp, "arrays.npz"), **flat)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"step": step, "keys": sorted(flat)}, f)
+        # Integrity manifest (atomic in its own right, and committed by the
+        # directory rename below): per-array crc32 + the digest that names
+        # this checkpoint's weight_version for the serving control plane.
+        write_manifest(tmp, flat, step)
         self._commit(tmp, step)
 
     # Shared filesystem pieces — one definition each, so the sync and async
@@ -314,6 +465,14 @@ class CheckpointManager:
         path = os.path.join(ckpt_dir, "arrays.npz")
         with np.load(path) as data:
             flat = {k: data[k] for k in data.files}
+        return self._restore_replicated(target, flat)
+
+    @staticmethod
+    def _restore_replicated(target: Any, flat: dict) -> Any:
+        """Rebuild ``target``'s tree from already-loaded flat arrays — the
+        replicated-format half of :meth:`restore`, shared with
+        ``restore_latest``'s verify-then-restore path so a manifest check
+        never re-reads the npz it just checksummed."""
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
         new_leaves = []
         for p, leaf in leaves_with_path:
@@ -445,6 +604,21 @@ class CheckpointManager:
         last_exc: Exception | None = None
         for step in reversed(steps):
             try:
+                ckpt_dir = os.path.join(self.directory, f"ckpt_{step:08d}")
+                if os.path.exists(os.path.join(ckpt_dir, MANIFEST_NAME)):
+                    # Manifest-bearing checkpoints (replicated format)
+                    # verify BYTES before the structural probe gets a say:
+                    # a flipped bit that still unpickles into the right
+                    # shapes would pass the probe and silently restore
+                    # garbage — the crc32 table catches it and falls back
+                    # like any torn npz. The arrays are loaded ONCE and
+                    # restored from the same verified dict.
+                    with np.load(
+                        os.path.join(ckpt_dir, "arrays.npz")
+                    ) as data:
+                        flat = {k: data[k] for k in data.files}
+                    verify_manifest(ckpt_dir, flat)
+                    return self._restore_replicated(target, flat)
                 return self.restore(target, step)
             except _CORRUPT_CHECKPOINT_ERRORS as e:
                 last_exc = e
