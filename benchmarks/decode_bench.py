@@ -94,6 +94,22 @@ def main() -> None:
                         "tokens/s, predicted peak bytes, KV bytes/slot, "
                         "and max concurrent slots before OOM-by-budget "
                         "(answers asserted byte-identical across layouts)")
+    p.add_argument("--decode_kernel", type=str, default="",
+                   help="comma-separated decode-kernel sweep "
+                        "('xla,paged_flash'): per KV-cache variant "
+                        "(bf16/int8/gqa), run the repeated-system-prompt "
+                        "workload through the paged continuous scheduler "
+                        "with each kernel and report tokens/s plus the cost "
+                        "model's predicted_bytes_moved for the batched pool "
+                        "step (answers asserted byte-identical across "
+                        "kernels)")
+    p.add_argument("--tpu", action="store_true",
+                   help="demand real-Pallas (interpret=False) decode-kernel "
+                        "rows: on a TPU backend the sweep rows compile the "
+                        "kernels for the MXU; anywhere else a "
+                        "bench.relay_probe fallback row records that the "
+                        "hardware row is still pending while the "
+                        "interpret-mode rows ride along")
     p.add_argument("--kv_pool_mb", type=float, default=0.0,
                    help="device-memory budget (MiB) the --kv_layout "
                         "max-slots column is computed against (0 = the "
@@ -207,10 +223,13 @@ def main() -> None:
     # and a memory regression shows up in the same file as a speed one.
     from transformer_tpu.analysis.costs import program_costs
 
-    def _predict(fn, *abstract_args, donate_argnums=()):
+    def _costs(fn, *abstract_args, donate_argnums=()):
         return program_costs(
             "bench", fn, *abstract_args, donate_argnums=donate_argnums
-        ).peak_bytes
+        )
+
+    def _predict(fn, *abstract_args, donate_argnums=()):
+        return _costs(fn, *abstract_args, donate_argnums=donate_argnums).peak_bytes
 
     decode_peak = _predict(
         lambda p, t, c, pos: transformer_decode_step(
@@ -437,6 +456,135 @@ def main() -> None:
                 f"kv_layout={layout} changed answers vs {first}"
             )
 
+    # ---- decode kernel sweep (paged continuous scheduler) -----------------
+    # Headline: tokens/s per kernel next to the cost model's
+    # predicted_bytes_moved for the batched pool step — the fused
+    # paged_flash path exists to cut the gathered-view HBM pass, so the
+    # prediction that justifies it lands in the same row as the
+    # measurement. On CPU the kernels run in Pallas interpret mode (shape
+    # check, not a speed claim); --tpu marks the interpret=False rows that
+    # light up when the relay returns.
+    kernels = [x.strip() for x in args.decode_kernel.split(",") if x.strip()]
+    if args.tpu and not kernels:
+        kernels = ["xla", "paged_flash"]
+    kernel_rows = []
+    relay_row = None
+    if kernels:
+        from transformer_tpu.serve import ContinuousScheduler
+        from transformer_tpu.serve.scheduler import (
+            _pool_step_paged,
+            _pool_step_paged_flash,
+            abstract_paged_pool,
+        )
+
+        on_tpu = dev.platform == "tpu"
+        if args.tpu and not on_tpu:
+            # Same contract as bench.py's banked-row fallback: the pending
+            # hardware measurement is recorded as an explicit probe row
+            # instead of silently missing from the round's diff.
+            relay_row = {
+                "metric": "bench.relay_probe",
+                "value": None,
+                "unit": "row",
+                "config": {
+                    "pending_metric": "decode kernel tokens/s",
+                    "decode_kernel": kernels,
+                    "kv_layout": "paged",
+                    "interpret": False,
+                },
+                "stale_reason": "TPU backend unavailable (relay down); "
+                                "real-Pallas decode-kernel rows pending",
+                "device": f"{dev.platform}:{dev.device_kind}",
+                "vs_baseline": None,
+            }
+        cache_variants = {
+            "bf16": {},
+            "int8": {"kv_cache_int8": True},
+            "gqa": {"num_kv_heads": max(1, args.heads // 2)},
+        }
+        kslots = 2
+        kblock = args.prefix_block
+        kreqs = _system_prompt_requests(
+            np.random.default_rng(2), args.vocab, args.prompt_len,
+            args.prefix_requests,
+        )
+        ktok = _IdTok()
+        # Workload rows per slot: bos + system prompt + 4-id tail + 4
+        # generated; pad so tiny smoke configs never trip the prompt-length
+        # validator.
+        ktotal = max(total, args.prompt_len + 16)
+        slot_blocks = -(-ktotal // kblock)
+        pool_blocks = 1 + kslots * slot_blocks
+        for vname, overrides in cache_variants.items():
+            vcfg = ModelConfig(
+                num_layers=args.layers, d_model=args.d_model,
+                num_heads=args.heads, dff=args.dff,
+                input_vocab_size=args.vocab, target_vocab_size=args.vocab,
+                max_position=ktotal, decoder_only=True, tie_output=True,
+                dtype="bfloat16", dropout_rate=0.0, **overrides,
+            )
+            vparams = transformer_init(jax.random.PRNGKey(0), vcfg)
+            vanswers = {}
+            for kernel in kernels:
+                sched = ContinuousScheduler(
+                    vparams, vcfg, ktok, num_slots=kslots,
+                    prefill_chunk=args.chunk, kv_layout="paged",
+                    kv_block=kblock, max_total=ktotal, decode_kernel=kernel,
+                )
+                t0 = time.perf_counter()
+                out = sched.run([dict(r) for r in kreqs])
+                wall = time.perf_counter() - t0
+                assert all("continuation" in r for r in out), out
+                vanswers[kernel] = [r["continuation"] for r in out]
+                new_tokens = sum(
+                    len(ktok.encode(r["continuation"])) for r in out
+                )
+                if kernel == "paged_flash":
+                    raw = _costs(
+                        lambda p, c, tb, ix, t, vcfg=vcfg: (
+                            _pool_step_paged_flash.__wrapped__(
+                                p, c, tb, ix, t, vcfg, kblock, False
+                            )
+                        ),
+                        vparams,
+                        *abstract_paged_pool(
+                            vcfg, kslots, ktotal, pool_blocks, kblock
+                        ),
+                        jnp.zeros((kslots,), jnp.int32),
+                        donate_argnums=(1,),
+                    )
+                else:
+                    raw = _costs(
+                        lambda p, c, tb, ix, t, vcfg=vcfg: (
+                            _pool_step_paged.__wrapped__(
+                                p, c, tb, ix, t, vcfg, kblock, ktotal
+                            )
+                        ),
+                        vparams,
+                        *abstract_paged_pool(
+                            vcfg, kslots, ktotal, pool_blocks, kblock
+                        ),
+                        jnp.zeros((kslots,), jnp.int32),
+                        donate_argnums=(1,),
+                    )
+                kernel_rows.append({
+                    "cache_variant": vname,
+                    "decode_kernel": kernel,
+                    "tokens_per_sec": (
+                        round(new_tokens / wall, 1) if wall else None
+                    ),
+                    "wall_s": round(wall, 3),
+                    "predicted_bytes_moved": raw.bytes_moved,
+                    "predicted_peak_bytes": raw.peak_bytes,
+                    "interpret": kernel == "paged_flash" and not on_tpu,
+                })
+            base = kernels[0]
+            for kernel in kernels[1:]:
+                assert vanswers[kernel] == vanswers[base], (
+                    f"decode_kernel={kernel} changed answers vs {base} "
+                    f"({vname})"
+                )
+
     print(json.dumps({
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "decode_tokens_per_sec": round(decode_tok_s, 1),
@@ -452,7 +600,40 @@ def main() -> None:
         **({"speculative": speculative} if speculative else {}),
         **({"prefix_reuse": prefix} if prefix else {}),
         **({"kv_layouts": layout_rows} if layout_rows else {}),
+        **({"decode_kernels": kernel_rows} if kernel_rows else {}),
     }))
+
+    if kernel_rows or relay_row:
+        rows = [
+            json.dumps({
+                "metric": "decode kernel tokens/s",
+                "value": r["tokens_per_sec"],
+                "unit": "tokens/sec",
+                "config": {
+                    "layers": args.layers, "d_model": args.d_model,
+                    "heads": args.heads, "dff": args.dff,
+                    "prompt_len": args.prompt_len,
+                    "cache_variant": r["cache_variant"],
+                    "decode_kernel": r["decode_kernel"],
+                    "kv_layout": "paged",
+                    "block_tokens": args.prefix_block,
+                    "interpret": r["interpret"],
+                },
+                "predicted_bytes_moved": r["predicted_bytes_moved"],
+                "predicted_peak_bytes": r["predicted_peak_bytes"],
+                "device": f"{dev.platform}:{dev.device_kind}",
+                "vs_baseline": None,
+            })
+            for r in kernel_rows
+        ]
+        if relay_row is not None:
+            rows.append(json.dumps(relay_row))
+        if args.rows_out:
+            with open(args.rows_out, "a", encoding="utf-8") as f:
+                f.write("\n".join(rows) + "\n")
+        else:
+            for row in rows:
+                print(row, file=sys.stderr)
 
     if layout_rows:
         rows = [

@@ -37,6 +37,13 @@ def pytest_configure(config):
         "deterministic subset rides tier-1; the full sweep is also marked "
         "slow (`pytest -m chaos` runs every drill)",
     )
+    config.addinivalue_line(
+        "markers",
+        "pallas: Pallas kernel parity/retrace tests (interpret mode on "
+        "CPU) — a fast subset rides tier-1; the full variant x block-size "
+        "sweep is also marked slow (`pytest -m pallas` runs every kernel "
+        "test)",
+    )
 
 
 def launch_analysis_all_gate():

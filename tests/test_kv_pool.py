@@ -410,6 +410,21 @@ def test_paged_attention_matches_dense():
     want, _ = dot_product_attention(q, dense_k, dense_v, mask)
     got = paged_attention(q, k_pool, v_pool, table, lengths)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # width clamp: on a table wider than any slot needs (sink-padded
+    # columns), gathering only ceil(max lengths / B) blocks is BITWISE
+    # the gather a tightly-sized table would do — so short slots stop
+    # paying the nmax-wide gather for free. Against the unclamped wide
+    # gather the answers agree to fp32 reassociation (the extra positions
+    # carry softmax weight exactly 0.0, but a longer reduction axis lets
+    # XLA regroup the partial sums).
+    wide = jnp.concatenate([table, jnp.zeros((N, 2), jnp.int32)], axis=1)
+    width = -(-int(lengths.max()) // B) * B
+    unclamped = paged_attention(q, k_pool, v_pool, wide, lengths)
+    clamped = paged_attention(q, k_pool, v_pool, wide, lengths, width=width)
+    np.testing.assert_array_equal(np.asarray(clamped), np.asarray(want))
+    np.testing.assert_allclose(
+        np.asarray(clamped), np.asarray(unclamped), rtol=1e-6, atol=1e-6
+    )
     flash = paged_attention(
         q, k_pool, v_pool, table, lengths, impl="flash",
         block_q=8, block_k=8,

@@ -1,0 +1,314 @@
+"""Fused Pallas paged-decode kernels (``--decode_kernel paged_flash``):
+interpreter-mode parity of the block-table flash kernel against the XLA
+gather oracle across cache variants (bf16/int8/GQA) x speculative verify
+rows (S_q = k + 1, per-row offset causality) x fragmented/aliased tables;
+end-to-end answer byte-identity through the continuous scheduler (greedy +
+seeded sampling, chunked prefill, speculate_k, prefix aliasing incl. the
+CoW write-guard path); and the paged_flash retrace budget — zero
+steady-state recompiles across alloc/free/alias/spill admissions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.data.tokenizer import SubwordTokenizer
+from transformer_tpu.kernels.flash_attention import paged_attention
+from transformer_tpu.models import transformer_init
+from transformer_tpu.ops.attention import _quantize_kv
+from transformer_tpu.serve import ContinuousScheduler, PrefixCache
+
+pytestmark = pytest.mark.pallas
+
+
+def _cfg(tok, **kw) -> ModelConfig:
+    base = dict(
+        num_layers=2, d_model=16, num_heads=2, dff=32,
+        input_vocab_size=tok.model_vocab_size,
+        target_vocab_size=tok.model_vocab_size,
+        max_position=64, decoder_only=True, tie_output=True,
+        dtype="float32", dropout_rate=0.0,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return SubwordTokenizer.build_from_corpus(
+        ["ab cd ef gh ij kl mn"] * 3, target_vocab_size=300
+    )
+
+
+# Same acceptance matrix as the paged-vs-dense parity suite
+# (tests/test_kv_pool.py): bf16, int8, GQA; the windowed variant REFUSES
+# paged_flash (pinned below) because the kernel carries no band mask.
+VARIANTS = {
+    "bf16": dict(dtype="bfloat16"),
+    "int8": dict(kv_cache_int8=True),
+    "gqa": dict(num_kv_heads=1),
+}
+
+WAVES = [
+    [
+        {"prompt": "ab cd ef gh ij", "max_new": 6},
+        {"prompt": "ab cd ef gh kl", "max_new": 5, "temperature": 0.9,
+         "seed": 3},
+    ],
+    [
+        {"prompt": "ab cd ef gh ij", "max_new": 6},          # full hit
+        {"prompt": "ab cd ef gh mn", "max_new": 4, "temperature": 0.7,
+         "top_k": 4, "seed": 1},                             # partial hit
+    ],
+]
+
+
+# --------------------------------------------------------------------------
+# kernel-level parity: paged_flash vs the XLA gather oracle
+#
+# The oracle ("xla") is bitwise-identical to the dense cache path
+# (test_kv_pool.test_paged_attention_matches_dense), so agreement here
+# chains to the dense math. The kernel's per-element scores match the
+# oracle exactly (the QK contraction is only over D); what differs is the
+# softmax/PV reduction ORDER (online accumulation across blocks vs one
+# dense reduction), a low-bit effect bounded per compute dtype.
+
+_TOL = {"fp32": 5e-6, "bf16": 3e-2, "int8": 3e-2, "gqa": 3e-2}
+
+_KERNEL_VARIANTS = {
+    "fp32": dict(dtype=jnp.float32, h_q=2, h_kv=2, quantized=False),
+    "bf16": dict(dtype=jnp.bfloat16, h_q=2, h_kv=2, quantized=False),
+    "int8": dict(dtype=jnp.bfloat16, h_q=2, h_kv=2, quantized=True),
+    "gqa": dict(dtype=jnp.bfloat16, h_q=4, h_kv=1, quantized=False),
+}
+
+
+def _pool_case(variant: str, s_q: int, block_tokens: int = 8, seed: int = 0):
+    """A deliberately hostile pool: 7 blocks, every row filled with random
+    data (stale rows hold garbage the mask must hide), fragmented
+    out-of-order tables, slot 2 aliasing slot 0's first two blocks (a
+    prefix hit / pre-CoW share), unused entries parked on sink block 0,
+    and per-slot lengths that end mid-block."""
+    spec = _KERNEL_VARIANTS[variant]
+    rng = np.random.default_rng(seed)
+    d, blocks, n = 8, 7, 3
+    table = jnp.asarray(
+        [[3, 5, 1, 0], [6, 2, 4, 0], [3, 5, 2, 0]], jnp.int32
+    )
+    index = jnp.asarray(
+        [block_tokens + 2, block_tokens // 2, 2 * block_tokens - 2],
+        jnp.int32,
+    )
+    lengths = index + s_q
+    kf = rng.standard_normal((blocks, block_tokens, spec["h_kv"], d))
+    vf = rng.standard_normal((blocks, block_tokens, spec["h_kv"], d))
+    q = jnp.asarray(
+        rng.standard_normal((n, s_q, spec["h_q"], d)), spec["dtype"]
+    )
+    if spec["quantized"]:
+        k, k_scale = _quantize_kv(jnp.asarray(kf, jnp.float32))
+        v, v_scale = _quantize_kv(jnp.asarray(vf, jnp.float32))
+        return q, k, v, table, lengths, dict(k_scale=k_scale, v_scale=v_scale)
+    return (
+        q,
+        jnp.asarray(kf, spec["dtype"]),
+        jnp.asarray(vf, spec["dtype"]),
+        table,
+        lengths,
+        {},
+    )
+
+
+def _assert_kernel_parity(variant: str, s_q: int, block_tokens: int = 8):
+    q, k, v, table, lengths, kw = _pool_case(variant, s_q, block_tokens)
+    want = paged_attention(q, k, v, table, lengths, impl="xla", **kw)
+    got = paged_attention(
+        q, k, v, table, lengths, impl="paged_flash", interpret=True, **kw
+    )
+    assert got.shape == want.shape and got.dtype == want.dtype
+    tol = _TOL[variant]
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=tol, atol=tol,
+    )
+
+
+@pytest.mark.parametrize("s_q", [1, 3])
+@pytest.mark.parametrize("variant", sorted(_KERNEL_VARIANTS))
+def test_kernel_parity_matrix(variant, s_q):
+    """paged_flash vs the XLA oracle, per-variant tolerance: decode rows
+    (S_q=1) and speculative verify rows (S_q=k+1 — query i attends pool
+    positions <= lengths - S_q + i, per-row offset causality the S_q=1
+    flash impl cannot express), on fragmented/aliased tables."""
+    _assert_kernel_parity(variant, s_q)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("block_tokens", [4, 16])
+@pytest.mark.parametrize("variant", sorted(_KERNEL_VARIANTS))
+def test_kernel_parity_block_sizes(variant, block_tokens):
+    """The full sweep: every variant x non-default pool block sizes
+    (tier-1 pins block_tokens=8 above), verify-shaped rows throughout."""
+    _assert_kernel_parity(variant, 3, block_tokens)
+
+
+def test_kernel_skips_sink_blocks():
+    """Out-of-length table entries are never read: rewriting them to
+    arbitrary (even out-of-range-of-length) block ids leaves the output
+    bit-identical, pinning the stale-row/sink masking the pool's free
+    list relies on."""
+    q, k, v, table, lengths, kw = _pool_case("bf16", 1)
+    base = paged_attention(
+        q, k, v, table, lengths, impl="paged_flash", interpret=True, **kw
+    )
+    hostile = table.at[:, -1].set(jnp.asarray([4, 1, 6], jnp.int32))
+    got = paged_attention(
+        q, k, v, hostile, lengths, impl="paged_flash", interpret=True, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+
+# --------------------------------------------------------------------------
+# end-to-end: scheduler answers byte-identical paged_flash vs xla
+
+
+def _kernel_stack_parity(tok, variant: str, speculate_k: int) -> None:
+    """Greedy AND seeded-sampled answers byte-identical between
+    --decode_kernel xla and paged_flash on the SAME paged layout, composed
+    with chunked prefill, speculative decoding, and prefix reuse (wave 2
+    replays wave 1's prompts as aliased device hits; divergent tails
+    exercise the CoW write guard), at zero steady-state recompiles of the
+    fused per-step program."""
+    from transformer_tpu.serve import scheduler as sched
+
+    cfg = _cfg(tok, **VARIANTS[variant])
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    common = dict(
+        num_slots=2, max_total=48, default_max_new=4, prefill_chunk=3,
+        speculate_k=speculate_k, kv_layout="paged",
+    )
+    waves = [list(WAVES[0]), list(WAVES[1])]
+
+    s_ref = ContinuousScheduler(
+        params, cfg, tok, decode_kernel="xla",
+        prefix_cache=PrefixCache(cfg, block_tokens=4, budget_mb=8), **common,
+    )
+    want = [s_ref.run([dict(q) for q in w]) for w in waves]
+
+    s = ContinuousScheduler(
+        params, cfg, tok, decode_kernel="paged_flash",
+        prefix_cache=PrefixCache(cfg, block_tokens=4, budget_mb=8), **common,
+    )
+    step_fn = (
+        sched._pool_verify_paged_flash if speculate_k
+        else sched._pool_step_paged_flash
+    )
+    got = [s.run([dict(q) for q in waves[0]])]
+    before = step_fn._cache_size()
+    got.append(s.run([dict(q) for q in waves[1]]))
+    after = step_fn._cache_size()
+    assert got == want, f"paged_flash answers diverged from xla ({variant})"
+    assert any(r.get("continuation") for wave in got for r in wave), (
+        "vacuous parity: every continuation empty"
+    )
+    assert after == before, "steady-state recompile on the fused step"
+    # wave 2 replays wave 1's prompts: the fused path must still serve
+    # them as pure device-tier table aliases.
+    assert s.stats["prefix_hit_tokens"] > 0
+    assert s.stats["prefix_alias_tokens"] == s.stats["prefix_hit_tokens"]
+    s.pool.alloc.check_consistency()
+
+
+def test_kernel_stack_parity_speculative(tok):
+    """Tier-1 composition pin: bf16 + speculative verify (the fused
+    verify program) + chunked prefill + prefix aliasing."""
+    _kernel_stack_parity(tok, "bf16", speculate_k=1)
+
+
+def test_kernel_stack_parity_plain(tok):
+    """Tier-1 pin for the plain fused step (S_q = 1)."""
+    _kernel_stack_parity(tok, "bf16", speculate_k=0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant", ["int8", "gqa"])
+@pytest.mark.parametrize("speculate_k", [0, 1])
+def test_kernel_stack_parity_variant_matrix(tok, variant, speculate_k):
+    """The remaining answer-parity cross product: int8/GQA x plain and
+    speculative (full suite; bf16 rides tier-1)."""
+    _kernel_stack_parity(tok, variant, speculate_k=speculate_k)
+
+
+def test_windowed_config_refuses_paged_flash(tok):
+    """The kernel has no sliding-window band mask: attention_window
+    configs must be refused at scheduler init, not silently mis-served."""
+    cfg = _cfg(tok, attention_window=8)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="paged_flash|attention_window"):
+        ContinuousScheduler(
+            params, cfg, tok, num_slots=2, max_total=48,
+            decode_kernel="paged_flash",
+        )
+
+
+def test_unknown_decode_kernel_rejected(tok):
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="decode_kernel"):
+        ContinuousScheduler(
+            params, cfg, tok, num_slots=2, max_total=48,
+            decode_kernel="mxu_magic",
+        )
+
+
+# --------------------------------------------------------------------------
+# retrace budget: zero steady-state recompiles of the fused step
+
+
+def test_paged_flash_retrace_budget(tok):
+    """Steady-state paged_flash serving across every admission outcome —
+    fresh allocs, frees at retirement, device-tier alias hits, and
+    spill-to-host followed by batched restore — compiles ZERO new fused
+    step/prefill programs after one warmup round (the same budget
+    analysis/retrace.paged_retrace_report holds the gather path to);
+    greedy answers are byte-identical round over round."""
+    from transformer_tpu.analysis.retrace import RetraceSentinel
+    from transformer_tpu.serve import scheduler as sched
+
+    cfg = _cfg(tok)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    cache = PrefixCache(cfg, block_tokens=4, budget_mb=8)
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=48, default_max_new=4,
+        prefix_cache=cache, kv_layout="paged", decode_kernel="paged_flash",
+    )
+    wave = [
+        {"prompt": "ab cd ef gh ij"},
+        {"prompt": "ab cd ef kl"},
+    ]
+
+    def one_round():
+        out = s.run([dict(r) for r in wave])       # miss / alias / partial
+        # Spill rung: evict every device-tier block to the host trie, then
+        # re-serve — hits restore through the batched host write and are
+        # re-adopted, so the NEXT round aliases again.
+        s.stats["kv_spilled_blocks"] += cache.release_device_blocks(1 << 30)
+        out2 = s.run([dict(r) for r in wave])
+        s.pool.alloc.check_consistency()
+        return [r.get("continuation") for r in out + out2]
+
+    want = one_round()
+    assert any(want), "vacuous retrace drill: every continuation empty"
+    sentinel = RetraceSentinel()
+    sentinel.watch(
+        "decode(_pool_step_paged_flash)", sched._pool_step_paged_flash,
+        budget=0,
+    )
+    sentinel.watch(
+        "prefill(_slot_prefill_paged)", sched._slot_prefill_paged, budget=0
+    )
+    sentinel.snapshot()
+    for i in range(2):
+        assert one_round() == want, f"round {i} changed greedy answers"
+    sentinel.assert_within_budget()

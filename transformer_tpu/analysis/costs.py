@@ -60,7 +60,6 @@ from transformer_tpu.analysis.sharding import (
     _sub_jaxprs,
     canned_sharded_programs,
     collective_inventory,
-    walk_eqns_weighted,
 )
 
 # Primitives whose cost the FLOP model prices (the ISSUE's dot/conv/reduce
@@ -233,6 +232,41 @@ def _liveness_peak(jaxpr, initial_alive: dict[Any, int]) -> int:
     return max(peak, sum(alive.values()))
 
 
+def _pallas_grid_size(eqn) -> int:
+    """Total grid steps of a ``pallas_call`` equation (1 if unknown)."""
+    grid = getattr(eqn.params.get("grid_mapping"), "grid", None) or ()
+    n = 1
+    for d in grid:
+        try:
+            n *= int(d)
+        except TypeError:  # symbolic / dynamic dims: leave unweighted
+            return 1
+    return max(1, n)
+
+
+def _walk_eqns_hbm(jaxpr, weight: int = 1, in_kernel: bool = False):
+    """``walk_eqns_weighted`` with Pallas awareness: yields ``(eqn, weight,
+    in_kernel)``. A kernel BODY's equations run once per grid step (weight
+    multiplied by the grid size — that is what their FLOPs cost), but their
+    ref reads/writes move VMEM, not HBM: the ``pallas_call`` equation
+    itself, priced once over its operands and outputs, is the program's HBM
+    statement — exactly the proxy the gather path gets from its ``take``
+    equations. (``pl.when``-guarded steps still count: the weighting is a
+    static upper bound, same spirit as the scan trip-count multiply.)"""
+    for eqn in jaxpr.eqns:
+        yield eqn, weight, in_kernel
+        mult = weight
+        kernel = in_kernel
+        if eqn.primitive.name == "scan":
+            mult = weight * int(eqn.params.get("length", 1))
+        elif eqn.primitive.name == "pallas_call":
+            kernel = True
+            mult = weight * _pallas_grid_size(eqn)
+        for value in eqn.params.values():
+            for sub in _sub_jaxprs(value):
+                yield from _walk_eqns_hbm(sub, mult, kernel)
+
+
 def jaxpr_costs(
     name: str,
     closed,
@@ -258,10 +292,13 @@ def jaxpr_costs(
 
     flops = 0
     moved = 0
-    for eqn, weight in walk_eqns_weighted(jaxpr):
+    for eqn, weight, in_kernel in _walk_eqns_hbm(jaxpr):
         flops += weight * _eqn_flops(eqn)
-        if eqn.primitive.name in _CALL_PRIMS:
-            continue  # their bodies are walked; don't double-count the call
+        if in_kernel or eqn.primitive.name in _CALL_PRIMS:
+            # Call bodies are walked (don't double-count the call); Pallas
+            # kernel bodies move VMEM, not HBM (the pallas_call equation
+            # already priced the HBM side).
+            continue
         moved += weight * (
             sum(_aval_bytes(v.aval) for v in eqn.invars if hasattr(v, "aval"))
             + sum(_aval_bytes(v.aval) for v in eqn.outvars)
@@ -455,6 +492,37 @@ def canned_cost_reports() -> tuple[list[CostReport], list[str]]:
             f"serve.pool_step_paged[{variant}]",
             lambda p, c, tb, ix, t: step_raw(
                 p, c, tb, ix, t, cfg, _PAGED_BLOCK, _SERVE_TOTAL
+            ),
+            params, pool, table, index, i32(_SERVE_SLOTS),
+            donate_argnums=(1,),
+        )
+        r.extras["kv_bytes_per_slot"] = kv_pool_bytes(
+            cfg, _SERVE_TOTAL, _SERVE_SLOTS, _PAGED_POOL_BLOCKS, _PAGED_BLOCK
+        )["bytes_per_slot"]
+        reports.append(r)
+
+    # -- the FUSED paged decode hot loop (--decode_kernel paged_flash) ------
+    # Same shapes and donation as the gather twins, but attention reads the
+    # pool buffers in place through the block table and the dense-FFN
+    # sublayer is one Pallas kernel: the dense-ordered gathered view (one
+    # full pool pass written then re-read per step) and the per-sublayer HBM
+    # round trips are gone from the program, so bytes_moved DROPS vs
+    # serve.pool_step_paged[...]. compare_to_baseline enforces the drop
+    # STRUCTURALLY (fused < gather, per variant) on the live reports — not
+    # just against the banked numbers — so un-fusing the path can never land
+    # silently. interpret=False prices the real TPU program; tracing never
+    # lowers, so no TPU is needed here.
+    for variant in PAGED_VARIANTS:
+        cfg = FAST_MATRIX[variant]
+        params = _abstract_model(cfg)
+        pool, table, index = abstract_paged_pool(
+            cfg, _SERVE_SLOTS, _SERVE_TOTAL, _PAGED_POOL_BLOCKS, _PAGED_BLOCK
+        )
+        flash_raw = sched._pool_step_paged_flash.__wrapped__
+        r = program_costs(
+            f"serve.pool_step_paged_flash[{variant}]",
+            lambda p, c, tb, ix, t: flash_raw(
+                p, c, tb, ix, t, cfg, _PAGED_BLOCK, False
             ),
             params, pool, table, index, i32(_SERVE_SLOTS),
             donate_argnums=(1,),
@@ -699,6 +767,24 @@ def compare_to_baseline(
             now, was = getattr(r, field), base.get(field)
             if was is not None and now != was:
                 notes.append(f"{r.name}: {field} {was} -> {now} (advisory)")
+    # Structural fusion gate: every fused paged step must move strictly
+    # fewer bytes than its gather twin — the eliminated dense-view HBM pass
+    # is THE banked win of the paged_flash kernels, and unlike the advisory
+    # per-program bytes_moved drift, the fused-vs-gather ORDERING is a
+    # property of the program structure, not of jax lowering versions.
+    by_name = {r.name: r for r in reports}
+    for name in sorted(by_name):
+        if not name.startswith("serve.pool_step_paged_flash["):
+            continue
+        twin = by_name.get(
+            name.replace("pool_step_paged_flash", "pool_step_paged")
+        )
+        if twin is not None and by_name[name].bytes_moved >= twin.bytes_moved:
+            regressions.append(
+                f"{name}: bytes_moved {by_name[name].bytes_moved} >= gather "
+                f"twin's {twin.bytes_moved} ({twin.name}) — the fused kernel "
+                "no longer eliminates the gathered-view HBM pass"
+            )
     skipped = set(skipped)
     for name in sorted(set(base_programs) - seen):
         if name in skipped:

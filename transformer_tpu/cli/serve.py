@@ -144,6 +144,17 @@ def define_serve_flags() -> None:
         "Smaller pools bound resident KV by used tokens; under pressure "
         "the device-resident prefix tier spills to host and, as the last "
         "rung, the requesting slot answers a structured 'resource' error")
+    flags.DEFINE_enum(
+        "decode_kernel", "xla", ["xla", "paged_flash"],
+        "decode/verify kernel for the paged continuous-batching path: "
+        "'xla' gathers a dense view of each slot's KV through the block "
+        "table (the bitwise parity reference and CPU fallback); "
+        "'paged_flash' runs the fused Pallas kernels that read pool "
+        "blocks in place (no gathered view) plus the fused "
+        "residual+LN+FFN step — requires --kv_layout paged, a "
+        "decoder-only config without attention_window; answers are "
+        "byte-identical to 'xla'. Off-TPU backends run the kernels in "
+        "Pallas interpret mode (a correctness path, not a fast one)")
     flags.DEFINE_integer(
         "max_backlog", 0,
         "bounded admission backpressure for the continuous-batching path: "
@@ -548,6 +559,7 @@ def main(argv) -> None:
             kv_layout=FLAGS.kv_layout,
             kv_block=FLAGS.prefix_block,
             kv_pool_blocks=FLAGS.kv_pool_blocks,
+            decode_kernel=FLAGS.decode_kernel,
             admission_retries=FLAGS.admission_retries,
             breaker_threshold=FLAGS.breaker_threshold,
             breaker_cooldown_s=FLAGS.breaker_cooldown,

@@ -791,6 +791,9 @@ def paged_attention(
     lengths: jax.Array,
     *,
     impl: str = "xla",
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    width: int | None = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
@@ -801,24 +804,52 @@ def paged_attention(
       q: (N, S_q, H, D) queries; row ``s`` sits at absolute positions
         ``lengths[s] - S_q .. lengths[s] - 1`` (decode: S_q = 1 at the
         newest position, already written into the pool).
-      k_pool, v_pool: (num_blocks, B, H_kv, D) pool buffers (bf16/fp32;
-        int8 pools dequantize before calling — the serving path fuses the
-        dequant into its gathered view).
+      k_pool, v_pool: (num_blocks, B, H_kv, D) pool buffers — bf16/fp32
+        values, or int8 codes paired with ``k_scale``/``v_scale``.
       table: (N, nmax) int32 block table (``kernels/kv_pool.KVPool``).
       lengths: (N,) int32 valid KV length per sequence — positions
         ``>= lengths[s]`` (stale rows, sink gathers) are masked out.
       impl: "xla" — bitwise-identical math to the dense cache path
         (gather + fp32-softmax ``dot_product_attention``); "flash" — the
         Pallas blockwise kernel over the gathered view (decode S_q=1
-        only: its key-padding mask carries no per-row causality).
+        only: its key-padding mask carries no per-row causality);
+        "paged_flash" — the fused Pallas kernel reading pool blocks in
+        place through the table, no gathered view (any S_q, per-row
+        offset causality, int8 dequant and GQA grouping fused).
+      k_scale, v_scale: (num_blocks, B, H_kv, 1) fp32 dequant scales for
+        int8 pools. "xla"/"flash" dequantize the gathered view (same
+        round trip as the serving path); "paged_flash" consumes
+        codes + scales inside the kernel.
+      width: gather width in TOKENS (a multiple of the block size,
+        typically ``ceil(max lengths / B) * B``). Clamps the gathered
+        view so short slots don't pay an nmax-wide gather; positions
+        beyond every slot's length carry softmax weight exactly 0.0 in
+        fp32, so the clamp is bitwise-invisible. Ignored by
+        "paged_flash" (the kernel skips out-of-length blocks instead).
 
     Returns (N, S_q, H, D) attention outputs in q's dtype.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("int8 pools need BOTH k_scale and v_scale")
+    n, s_q = q.shape[:2]
+    if impl == "paged_flash":
+        from transformer_tpu.kernels.paged_flash import paged_flash_attention
+
+        return paged_flash_attention(
+            q, k_pool, v_pool, table, lengths,
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+        )
     from transformer_tpu.kernels.kv_pool import gather_block_views
 
-    n, s_q = q.shape[:2]
-    k = gather_block_views(k_pool, table)  # (N, L, H_kv, D)
-    v = gather_block_views(v_pool, table)
+    k = gather_block_views(k_pool, table, width=width)  # (N, L, H_kv, D)
+    v = gather_block_views(v_pool, table, width=width)
+    if k_scale is not None:
+        k = k.astype(q.dtype) * gather_block_views(
+            k_scale, table, width=width
+        ).astype(q.dtype)
+        v = v.astype(q.dtype) * gather_block_views(
+            v_scale, table, width=width
+        ).astype(q.dtype)
     L = k.shape[1]
     if impl == "flash":
         if s_q != 1:

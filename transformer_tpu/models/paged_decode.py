@@ -1,0 +1,194 @@
+"""Fused paged decode forward: the batched LM step over pool slots.
+
+The gather twins (``serve/scheduler.py`` ``_pool_step_paged`` /
+``_pool_verify_paged``) run decode as ``vmap`` over per-slot batch-1
+``transformer_decode_step`` calls against dense VIEWS of the pool — which
+forces ``gather_block_views`` to materialize every slot's whole KV working
+set in dense order before attention even starts, and leaves each sublayer's
+intermediates round-tripping HBM between XLA fusions. This module is the
+same step built on the fused kernels instead:
+
+- attention consumes the pool buffers in place through the block table
+  (``kernels/paged_flash.paged_flash_attention`` — no gathered view, GQA
+  grouping and int8 dequant inside the kernel);
+- the dense FFN sublayer runs as one residual+LN+FFN kernel
+  (``ops/ffn.fused_ln_ffn`` — the dff-wide intermediate never leaves VMEM);
+- everything else (embedding prologue, q/k/v/out projections, RoPE,
+  LayerNorms, pool scatter) reuses the exact ops the gather path reaches
+  through ``transformer_decode_step``, so the two paths share numerics
+  wherever fusion doesn't force a different reduction order.
+
+Write-then-attend: each layer scatters its freshly projected (and, for int8
+pools, freshly quantized) K/V rows into the pool FIRST, then attends through
+the table — the kernel's pool read hands back exactly the
+quantize->dequantize round trip ``_store_kv`` returns on the dense path, so
+stored rows and attended values stay bit-identical between paths. The S_q
+rows just written are visible to the attention (lengths = index + S_q) with
+per-row offset causality inside the kernel, which is what serves both
+one-token decode (S_q = 1) and speculative verify (S_q = k + 1).
+
+Scope guards (the gather path remains the general fallback): decoder-only
+LM configs, no attention window (the paged-flash kernel has no band mask —
+windowed configs keep the gather path, whose prefix mask carries the band),
+deterministic (dropout-free) decode. MoE FFN layers fall back to the XLA
+sublayer per layer; their attention still runs fused.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from transformer_tpu.config import ModelConfig
+from transformer_tpu.kernels.flash_attention import paged_attention
+from transformer_tpu.kernels.kv_pool import block_row_ids, scatter_rows
+from transformer_tpu.models.encoder import (
+    _ffn_sublayer_apply,
+    _sublayer,
+    embed_prologue,
+    layer_uses_moe,
+)
+from transformer_tpu.models.transformer import project_logits
+from transformer_tpu.ops.attention import _project, _quantize_kv, kv_buffer_keys
+from transformer_tpu.ops.ffn import fused_ln_ffn
+from transformer_tpu.ops.nn import Params, layernorm_apply
+from transformer_tpu.ops.positional import apply_rope
+
+
+def check_paged_flash_config(cfg: ModelConfig) -> None:
+    """Reject configs the fused path cannot serve (they keep the gather
+    path): the guards are static, so the scheduler validates once at init."""
+    if not cfg.decoder_only:
+        raise ValueError("paged_flash decode serves decoder-only LM configs")
+    if cfg.attention_window:
+        raise ValueError(
+            "paged_flash decode has no sliding-window band mask; serve "
+            "attention_window configs with --decode_kernel xla"
+        )
+
+
+def _scatter_layer_kv(
+    pool: dict[str, Any],
+    k: jax.Array,
+    v: jax.Array,
+    rids: jax.Array,
+) -> dict[str, Any]:
+    """Write (N, S_q, H_kv, D) projections into the pool at flat rows
+    ``rids`` — ``_store_kv``'s int8 layout decisions, re-aimed at pool
+    scatter (codes AND their fp32 scales land together, so stale scales can
+    never pair with fresh codes)."""
+    n, s_q = k.shape[:2]
+
+    def flat(t):
+        return t.reshape(n * s_q, *t.shape[2:])
+
+    if "k_scale" in pool:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        vals = {"k": flat(kq), "k_scale": flat(ks), "v": flat(vq), "v_scale": flat(vs)}
+    else:
+        vals = {"k": flat(k.astype(pool["k"].dtype)), "v": flat(v.astype(pool["v"].dtype))}
+    return {key: scatter_rows(pool[key], rids, vals[key]) for key in kv_buffer_keys(pool)}
+
+
+def paged_decode_forward(
+    params: Params,
+    toks: jax.Array,
+    pool_caches: list[dict[str, Any]],
+    table: jax.Array,
+    index: jax.Array,
+    cfg: ModelConfig,
+    *,
+    block_tokens: int,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, list[dict[str, Any]]]:
+    """One fused decode/verify forward over every pool slot.
+
+    Args:
+      params: full transformer params (decoder-only config).
+      toks: (N, S_q) int32 token ids — S_q = 1 for plain decode, k + 1 for
+        speculative verify (scored causally inside the row).
+      pool_caches: per-layer ``init_block_pool`` buffers.
+      table: (N, nmax) int32 device block table.
+      index: (N,) int32 per-slot positions BEFORE this forward; slot s's
+        tokens sit at absolute positions ``index[s] .. index[s] + S_q - 1``.
+      block_tokens: pool block size (static).
+      interpret: Pallas interpret mode for both kernels (default: off-TPU).
+
+    Returns ((N, S_q, vocab) logits for every fed position, updated pools).
+    Free slots (index 0, all-sink tables) produce garbage logits into rows
+    the host discards and write only sink rows — same contract as the
+    gather twins.
+    """
+    dec = params["decoder"]
+    n, s_q = toks.shape
+    index = index.astype(jnp.int32)
+    lengths = index + s_q
+    rids = block_row_ids(table, index, s_q, block_tokens).reshape(-1)
+
+    # Per-slot batch-1 embed, vmapped — the same call shape the gather path
+    # reaches through vmap(transformer_decode_step), so traced-offset
+    # handling (sinusoidal slack rows) and numerics line up exactly.
+    def embed_one(ids, pos):
+        return embed_prologue(dec["embedding"], ids[None], cfg, None, True, pos)[0]
+
+    x = jax.vmap(embed_one)(toks, index)  # (N, S_q, d_model)
+    dtype = x.dtype
+    rope = cfg.position_scheme == "rope"
+
+    new_pools: list[dict[str, Any]] = []
+    for i, layer in enumerate(dec["layers"]):
+        pool = pool_caches[i]
+        pool_box = [pool]
+
+        def self_attn(h, layer=layer, pool_box=pool_box):
+            mp = layer["self_mha"]
+            q = _project(mp["query"], h, dtype)
+            k = _project(mp["key"], h, dtype)
+            v = _project(mp["value"], h, dtype)
+            if rope:
+                rot = jax.vmap(
+                    lambda t, off: apply_rope(t[None], off + jnp.arange(s_q))[0]
+                )
+                q = rot(q, index)
+                k = rot(k, index)
+            pool = _scatter_layer_kv(pool_box[0], k, v, rids)
+            pool_box[0] = pool
+            quant = {"k_scale": pool["k_scale"], "v_scale": pool["v_scale"]} if "k_scale" in pool else {}
+            out = paged_attention(
+                q, pool["k"], pool["v"], table, lengths,
+                impl="paged_flash", interpret=interpret, **quant,
+            )
+            return jnp.einsum(
+                "bshd,hdm->bsm", out, mp["out"]["kernel"].astype(dtype)
+            ) + mp["out"]["bias"].astype(dtype)
+
+        x = _sublayer(cfg, layer["ln1"], x, self_attn, None, True)
+        new_pools.append(pool_box[0])
+
+        if layer_uses_moe(cfg, i):
+            # MoE dispatch is data-dependent routing — its fusion is a
+            # separate kernel. Keep the XLA sublayer; attention above
+            # already ran fused.
+            aux_box: list = [None]
+            x = _sublayer(
+                cfg, layer["ln_ffn"], x,
+                lambda h, layer=layer, aux_box=aux_box: _ffn_sublayer_apply(
+                    layer, h, cfg, aux_box, None
+                ),
+                None, True,
+            )
+        else:
+            x = fused_ln_ffn(
+                layer["ln_ffn"], layer["ffn"], x,
+                activation=cfg.ffn_activation,
+                norm_scheme=cfg.norm_scheme,
+                epsilon=cfg.layernorm_epsilon,
+                interpret=interpret,
+            )
+
+    if cfg.norm_scheme == "pre":
+        x = layernorm_apply(dec["final_ln"], x, cfg.layernorm_epsilon)
+    return project_logits(params, x, cfg), new_pools

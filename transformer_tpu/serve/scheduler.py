@@ -124,6 +124,10 @@ import numpy as np
 from transformer_tpu.config import PAD_ID, ModelConfig
 from transformer_tpu.data.seeding import keyed_rng
 from transformer_tpu.models.decoder import init_decoder_caches
+from transformer_tpu.models.paged_decode import (
+    check_paged_flash_config,
+    paged_decode_forward,
+)
 from transformer_tpu.models.transformer import (
     transformer_decode_step,
     transformer_prefill,
@@ -392,6 +396,49 @@ def _pool_verify_paged(
     return logits, _paged_scatter(
         pool_caches, new_views, table, index, toks.shape[1], block_tokens
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "block_tokens", "interpret"),
+    donate_argnums=(1,),
+)
+def _pool_step_paged_flash(
+    params, pool_caches, table, index, toks, cfg: ModelConfig,
+    block_tokens: int, interpret: bool,
+):
+    """``_pool_step_paged`` on the fused kernels (--decode_kernel
+    paged_flash): one batched forward whose attention reads pool blocks in
+    place through the table — no gathered view, no per-slot vmap — and
+    whose dense-FFN sublayers run as single Pallas kernels
+    (``models/paged_decode.py``). Same signature family as the gather twin
+    minus ``buf_len`` (nothing dense-ordered exists to size)."""
+    logits, new_pools = paged_decode_forward(
+        params, toks[:, None], pool_caches, table, index, cfg,
+        block_tokens=block_tokens, interpret=interpret,
+    )
+    return logits[:, 0], new_pools
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "block_tokens", "interpret"),
+    donate_argnums=(1,),
+)
+def _pool_verify_paged_flash(
+    params, pool_caches, table, index, toks, cfg: ModelConfig,
+    block_tokens: int, interpret: bool,
+):
+    """``_pool_verify_paged`` on the fused kernels: W-wide speculative
+    rows scored in one forward — the paged-flash kernel's per-row offset
+    causality handles S_q = k + 1 directly (the gather-flash path's S_q=1
+    restriction does not apply). Rejected tails still roll back by HOST
+    table truncation, exactly like the gather twin."""
+    logits, new_pools = paged_decode_forward(
+        params, toks, pool_caches, table, index, cfg,
+        block_tokens=block_tokens, interpret=interpret,
+    )
+    return logits, new_pools
 
 
 @partial(
@@ -774,6 +821,7 @@ class ContinuousScheduler:
         kv_layout: str = "dense",
         kv_block: int = 16,
         kv_pool_blocks: int = 0,
+        decode_kernel: str = "xla",
         weight_version: "str | None" = None,
     ):
         if not cfg.decoder_only:
@@ -841,6 +889,28 @@ class ContinuousScheduler:
             kv_pool_blocks=kv_pool_blocks,
         )
         self.paged = self.pool.layout == "paged"
+        # ---- decode kernel selection (--decode_kernel) --------------------
+        # "xla": the gather-view programs — the bitwise parity reference and
+        # the fallback for every config. "paged_flash": the fused Pallas
+        # programs (models/paged_decode.py) that read pool blocks in place;
+        # paged layout only, and the config guards are static so a bad combo
+        # fails at construction, not at the first step. Off-TPU the kernels
+        # run in interpret mode — resolved ONCE here so the flag is a static
+        # jit arg (one executable per scheduler, not per backend probe).
+        if decode_kernel not in ("xla", "paged_flash"):
+            raise ValueError(
+                f"decode_kernel must be 'xla' or 'paged_flash', got "
+                f"{decode_kernel!r}"
+            )
+        if decode_kernel == "paged_flash":
+            if not self.paged:
+                raise ValueError(
+                    "decode_kernel='paged_flash' reads the block-pool "
+                    "buffers in place and needs kv_layout='paged'"
+                )
+            check_paged_flash_config(cfg)
+        self.decode_kernel = decode_kernel
+        self._kernel_interpret = jax.default_backend() != "tpu"
         if self.paged and prefix_cache is not None:
             # Device-resident prefix tier: retiring slots donate their
             # prompt blocks by aliasing (refcount, zero copies), hits
@@ -2074,9 +2144,16 @@ class ContinuousScheduler:
             keys[slot] = st.key
             positions[slot] = st.pos
             temps[slot] = st.temperature
-        if self.paged:
+        if self.paged and self.decode_kernel == "paged_flash":
+            logits, self.pool.caches = _pool_step_paged_flash(
+                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/elif/else triplet: exactly one branch runs per step and all rebind self.pool.caches from their own result
+                self.pool.alloc.table_device(), jnp.asarray(positions),
+                jnp.asarray(toks), self.cfg,
+                self.pool.block_tokens, self._kernel_interpret,
+            )
+        elif self.paged:
             logits, self.pool.caches = _pool_step_paged(
-                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/else twin of the dense donating call below: exactly one branch runs per step and both rebind self.pool.caches from their own result
+                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/elif/else triplet: exactly one branch runs per step and all rebind self.pool.caches from their own result
                 self.pool.alloc.table_device(), jnp.asarray(positions),
                 jnp.asarray(toks), self.cfg,
                 self.pool.block_tokens, self.pool.buf_len,
@@ -2202,9 +2279,16 @@ class ContinuousScheduler:
             verify_span = self._tracer.start_span(
                 "spec.verify", parent=step_span, lane="scheduler", width=W,
             )
-        if self.paged:
+        if self.paged and self.decode_kernel == "paged_flash":
+            logits, self.pool.caches = _pool_verify_paged_flash(
+                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/elif/else triplet: exactly one branch runs per step and all rebind self.pool.caches from their own result
+                self.pool.alloc.table_device(), jnp.asarray(positions),
+                jnp.asarray(toks), self.cfg,
+                self.pool.block_tokens, self._kernel_interpret,
+            )
+        elif self.paged:
             logits, self.pool.caches = _pool_verify_paged(
-                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/else twin of the dense donating call below: exactly one branch runs per step and both rebind self.pool.caches from their own result
+                self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/elif/else triplet: exactly one branch runs per step and all rebind self.pool.caches from their own result
                 self.pool.alloc.table_device(), jnp.asarray(positions),
                 jnp.asarray(toks), self.cfg,
                 self.pool.block_tokens, self.pool.buf_len,
