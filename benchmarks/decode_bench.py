@@ -100,8 +100,9 @@ def main() -> None:
                         "(bf16/int8/gqa), run the repeated-system-prompt "
                         "workload through the paged continuous scheduler "
                         "with each kernel and report tokens/s plus the cost "
-                        "model's predicted_bytes_moved for the batched pool "
-                        "step (answers asserted byte-identical across "
+                        "model's predicted_bytes_moved and the kernel "
+                        "verifier's predicted_vmem_bytes for the batched "
+                        "pool step (answers asserted byte-identical across "
                         "kernels)")
     p.add_argument("--tpu", action="store_true",
                    help="demand real-Pallas (interpret=False) decode-kernel "
@@ -539,20 +540,30 @@ def main() -> None:
                 new_tokens = sum(
                     len(ktok.encode(r["continuation"])) for r in out
                 )
+                kernel_vmem = {}
                 if kernel == "paged_flash":
-                    raw = _costs(
-                        lambda p, c, tb, ix, t, vcfg=vcfg: (
-                            _pool_step_paged_flash.__wrapped__(
-                                p, c, tb, ix, t, vcfg, kblock, False
-                            )
-                        ),
+                    step_fn = lambda p, c, tb, ix, t, vcfg=vcfg: (  # noqa: E731
+                        _pool_step_paged_flash.__wrapped__(
+                            p, c, tb, ix, t, vcfg, kblock, False
+                        )
+                    )
+                    step_args = (
                         vparams,
                         *abstract_paged_pool(
                             vcfg, kslots, ktotal, pool_blocks, kblock
                         ),
                         jnp.zeros((kslots,), jnp.int32),
-                        donate_argnums=(1,),
                     )
+                    raw = _costs(step_fn, *step_args, donate_argnums=(1,))
+                    # The verifier's per-grid-step VMEM model for each
+                    # Pallas kernel in the step; kernels run sequentially,
+                    # so the program's kernel-VMEM high-water mark is the
+                    # max, not the sum.
+                    from transformer_tpu.analysis.kernels import (
+                        program_kernel_vmem,
+                    )
+
+                    kernel_vmem = program_kernel_vmem(step_fn, *step_args)
                 else:
                     raw = _costs(
                         lambda p, c, tb, ix, t, vcfg=vcfg: (
@@ -576,8 +587,22 @@ def main() -> None:
                     "wall_s": round(wall, 3),
                     "predicted_bytes_moved": raw.bytes_moved,
                     "predicted_peak_bytes": raw.peak_bytes,
+                    "predicted_vmem_bytes": (
+                        max(kernel_vmem.values()) if kernel_vmem else 0
+                    ),
+                    "predicted_vmem_by_kernel": kernel_vmem,
                     "interpret": kernel == "paged_flash" and not on_tpu,
                 })
+                if kernel_vmem:
+                    per = ", ".join(
+                        f"{k}={v}" for k, v in sorted(kernel_vmem.items())
+                    )
+                    print(
+                        f"[decode_bench] {vname}/{kernel}: "
+                        f"predicted_vmem_bytes={max(kernel_vmem.values())} "
+                        f"({per})",
+                        file=sys.stderr,
+                    )
             base = kernels[0]
             for kernel in kernels[1:]:
                 assert vanswers[kernel] == vanswers[base], (
@@ -621,6 +646,7 @@ def main() -> None:
                 },
                 "predicted_bytes_moved": r["predicted_bytes_moved"],
                 "predicted_peak_bytes": r["predicted_peak_bytes"],
+                "predicted_vmem_bytes": r["predicted_vmem_bytes"],
                 "device": f"{dev.platform}:{dev.device_kind}",
                 "vs_baseline": None,
             })

@@ -965,7 +965,7 @@ def test_eventlog_canary_catches_unlocked_split_write():
 def test_analysis_all_cli_gate(request):
     """docs/ANALYSIS.md names `python -m transformer_tpu.analysis all` as
     THE pre-merge gate; this test makes pytest actually enforce it: the
-    shelled CLI must exit 0 with ALL SEVEN families run and clean, and the
+    shelled CLI must exit 0 with ALL EIGHT families run and clean, and the
     --format=json stream must parse (one JSON document per family, headers
     on stderr so stdout stays machine-readable). The subprocess is
     LAUNCHED at collection time (conftest pytest_collection_modifyitems)
@@ -980,23 +980,37 @@ def test_analysis_all_cli_gate(request):
     stdout, stderr = proc.communicate(timeout=580)
     assert proc.returncode == 0, (stdout[-2000:], stderr[-2000:])
     families = {"rules", "concurrency", "sharding", "schedules",
-                "contracts", "retrace", "costs"}
+                "contracts", "retrace", "costs", "kernels"}
     headers = {
         line.strip("= ").strip()
         for line in stderr.splitlines()
         if line.startswith("== ") and line.rstrip().endswith("==")
     }
     assert headers == families, headers
-    assert "7/7 families clean" in stderr, stderr[-2000:]
+    assert "8/8 families clean" in stderr, stderr[-2000:]
     # The stdout stream is a sequence of JSON documents — parse them all.
     decoder = json.JSONDecoder()
-    text, idx, docs = stdout, 0, 0
+    text, idx, docs = stdout, 0, []
     while idx < len(text):
         while idx < len(text) and text[idx].isspace():
             idx += 1
         if idx >= len(text):
             break
-        _, end = decoder.raw_decode(text, idx)
+        doc, end = decoder.raw_decode(text, idx)
         idx = end
-        docs += 1
-    assert docs == len(families), f"expected 7 JSON documents, got {docs}"
+        docs.append(doc)
+    assert len(docs) == len(families), (
+        f"expected {len(families)} JSON documents, got {len(docs)}"
+    )
+    # The TPA300 kernel-verifier family must be IN the stream (its doc is
+    # the only one carrying a per-kernel VMEM report).
+    kernel_docs = [
+        d for d in docs
+        if isinstance(d, dict) and "kernels" in d and "generation" in d
+    ]
+    assert len(kernel_docs) == 1, [sorted(d) for d in docs]
+    kdoc = kernel_docs[0]
+    assert kdoc["ok"] is True
+    assert kdoc["kernels"], "kernel verifier reported no sites"
+    names = {k["kernel"] for k in kdoc["kernels"]}
+    assert {"_fwd_kernel", "_paged_kernel", "_fused_kernel"} <= names, names

@@ -153,6 +153,22 @@ def test_kernel_parity_block_sizes(variant, block_tokens):
     _assert_kernel_parity(variant, 3, block_tokens)
 
 
+def test_kernel_rejects_untileable_block_tokens():
+    """Regression: a pool whose block_tokens neither divides nor is a
+    multiple of the dtype's native sublane (bf16 -> 16) used to reach the
+    kernel and produce silently wrong tiling; it must be rejected up front
+    with an actionable error."""
+    q, k, v, table, lengths, kw = _pool_case("bf16", 1, block_tokens=6)
+    with pytest.raises(ValueError, match="block_tokens 6 is incompatible"):
+        paged_attention(
+            q, k, v, table, lengths, impl="paged_flash", interpret=True, **kw
+        )
+    # The boundary cases stay accepted: divisor of the sublane and an
+    # exact multiple of it.
+    for ok_bt in (4, 32):
+        _assert_kernel_parity("bf16", 1, ok_bt)
+
+
 def test_kernel_skips_sink_blocks():
     """Out-of-length table entries are never read: rewriting them to
     arbitrary (even out-of-range-of-length) block ids leaves the output

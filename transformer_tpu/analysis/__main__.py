@@ -23,8 +23,14 @@ Subcommands (all CPU-safe; exit code 0 = clean, 1 = findings/violations):
   peak live-buffer bytes (donation-aware liveness), FLOPs, bytes moved,
   arithmetic intensity, and the collective inventory for every canned
   program, gated against ``analysis/costs_baseline.json`` budgets.
-- ``all [--only FAMILY,...]`` — every family above with ONE aggregate exit
-  code: the pre-merge gate (docs/ANALYSIS.md).
+- ``kernels [--paths P ...] [--baseline FILE] [--update-baseline]
+  [--generation G]`` — the TPA300 Pallas kernel verifier: grid/BlockSpec
+  conformance + index-map bounds enumerated over every grid, a
+  per-grid-step VMEM footprint model gated against
+  ``analysis/kernels_baseline.json``, and kernel-safety lints TPA301–305
+  — all abstract, zero device execution.
+- ``all [--only FAMILY,...]`` — every family above (8 families) with ONE
+  aggregate exit code: the pre-merge gate (docs/ANALYSIS.md).
 
 ``--format=json`` emits machine-readable output on every subcommand so
 rounds can diff finding counts like a bench (``bench.py`` row style).
@@ -162,6 +168,41 @@ def _cmd_costs(args: argparse.Namespace) -> int:
     return 0 if result.ok else 1
 
 
+def _cmd_kernels(args: argparse.Namespace) -> int:
+    _ensure_cpu_devices()
+    from transformer_tpu.analysis.kernels import (
+        default_kernels_baseline_path,
+        run_kernels,
+        summarize_kernels,
+        write_kernels_baseline,
+    )
+
+    baseline = args.baseline
+    if baseline is None and not args.paths:
+        baseline = default_kernels_baseline_path()
+    result = run_kernels(
+        paths=args.paths or None,
+        baseline_path=baseline,
+        compare=not args.update_baseline,
+        generation=getattr(args, "generation", None),
+    )
+    if args.update_baseline:
+        path = baseline or default_kernels_baseline_path()
+        if result.violations:
+            # Conformance/race/budget breaches are never baselineable.
+            for v in result.violations:
+                print(f"VIOLATION: {v}", file=sys.stderr)
+            return 1
+        write_kernels_baseline(result, path)
+        print(
+            f"banked {len(result.reports)} kernel(s), grandfathered "
+            f"{len(result.findings)} finding(s) -> {path}"
+        )
+        return 0
+    _emit(result.to_dict(), summarize_kernels(result), args.format)
+    return 0 if result.ok else 1
+
+
 def _cmd_all(args: argparse.Namespace) -> int:
     """Every analysis family, one aggregate exit code — the pre-merge gate."""
     _ensure_cpu_devices()
@@ -178,6 +219,7 @@ def _cmd_all(args: argparse.Namespace) -> int:
         "contracts": _cmd_contracts,
         "retrace": _cmd_retrace,
         "costs": _cmd_costs,
+        "kernels": _cmd_kernels,
     }
     only = (
         [f.strip() for f in args.only.split(",") if f.strip()]
@@ -358,6 +400,29 @@ def main(argv: list[str] | None = None) -> int:
         help="rewrite the budget baseline with the current numbers",
     )
 
+    p_kern = sub.add_parser(
+        "kernels", help="Pallas kernel verifier (TPA300-TPA305): grid/"
+        "BlockSpec conformance, VMEM budgets, safety lints"
+    )
+    p_kern.add_argument(
+        "--paths", nargs="*", default=None,
+        help="modules declaring ANALYSIS_KERNEL_ENTRIES to verify "
+        "(default: the package's canned kernel entries)",
+    )
+    p_kern.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: analysis/kernels_baseline.json "
+        "for package runs)",
+    )
+    p_kern.add_argument(
+        "--update-baseline", action="store_true",
+        help="bank current VMEM/FLOPs numbers and grandfather lint findings",
+    )
+    p_kern.add_argument(
+        "--generation", choices=("v4", "v5e", "v5p", "v6e"), default=None,
+        help="TPU generation for the VMEM budget (default v5e)",
+    )
+
     p_all = sub.add_parser(
         "all", help="run every analysis family; one aggregate exit code "
         "(the pre-merge gate)"
@@ -365,7 +430,7 @@ def main(argv: list[str] | None = None) -> int:
     p_all.add_argument(
         "--only", default=None,
         help="comma-separated family subset (rules,concurrency,sharding,"
-        "schedules,contracts,retrace,costs)",
+        "schedules,contracts,retrace,costs,kernels)",
     )
 
     p_sched = sub.add_parser(
@@ -401,8 +466,8 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     for p in (
-        p_rules, p_conc, p_shard, p_costs, p_all, p_sched, p_contracts,
-        p_retrace,
+        p_rules, p_conc, p_shard, p_costs, p_kern, p_all, p_sched,
+        p_contracts, p_retrace,
     ):
         p.add_argument(
             "--format", choices=("text", "json"), default="text",
@@ -415,6 +480,7 @@ def main(argv: list[str] | None = None) -> int:
         "concurrency": _cmd_concurrency,
         "sharding": _cmd_sharding,
         "costs": _cmd_costs,
+        "kernels": _cmd_kernels,
         "all": _cmd_all,
         "schedules": _cmd_schedules,
         "contracts": _cmd_contracts,

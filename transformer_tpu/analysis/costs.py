@@ -267,6 +267,20 @@ def _walk_eqns_hbm(jaxpr, weight: int = 1, in_kernel: bool = False):
                 yield from _walk_eqns_hbm(sub, mult, kernel)
 
 
+def pallas_call_flops(eqn, outer_weight: int = 1) -> int:
+    """Grid-weighted FLOPs of ONE ``pallas_call`` equation, priced with the
+    SAME walk/pricing helpers ``jaxpr_costs`` uses — the kernel verifier
+    (analysis/kernels.py) reports this number, so the two families cannot
+    drift (tests assert the totals agree eqn-for-eqn)."""
+    total = 0
+    mult = outer_weight * _pallas_grid_size(eqn)
+    for value in eqn.params.values():
+        for sub in _sub_jaxprs(value):
+            for e, w, _ in _walk_eqns_hbm(sub, mult, True):
+                total += w * _eqn_flops(e)
+    return total
+
+
 def jaxpr_costs(
     name: str,
     closed,

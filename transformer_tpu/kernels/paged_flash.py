@@ -189,6 +189,18 @@ def paged_flash_attention(
         raise ValueError(f"query heads {h} must be a multiple of kv heads {h_kv}")
     if (k_scale is None) != (v_scale is None):
         raise ValueError("int8 pools need BOTH k_scale and v_scale")
+    # Mosaic packs the pool's token axis into (sublane, lane) vregs whose
+    # sublane count depends on the element width: 8 rows for fp32, 16 for
+    # bf16, 32 for int8. A block_tokens that neither divides nor is a
+    # multiple of that count forces a mid-vreg block boundary the lowering
+    # rejects with an opaque shape error — fail loudly at call time instead.
+    sublane = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(k_pool.dtype).itemsize, 8)
+    if block_tokens % sublane and sublane % block_tokens:
+        raise ValueError(
+            f"block_tokens {block_tokens} is incompatible with the "
+            f"{jnp.dtype(k_pool.dtype).name} pool's native sublane tiling "
+            f"({sublane}): it must divide {sublane} or be a multiple of it"
+        )
     quantized = k_scale is not None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
