@@ -121,6 +121,14 @@ def main() -> None:
                         "--speculate_k / --prefix_reuse sweeps to this file "
                         "('' = print them to stderr; stdout stays one "
                         "summary JSON line)")
+    p.add_argument("--metrics_jsonl", type=str, default="",
+                   help="append obs telemetry events for the scheduler "
+                        "sweeps to this JSONL (each sweep row's final "
+                        "metrics.snapshot carries its per-program perf_* "
+                        "profiler metrics) — the episode `python -m "
+                        "transformer_tpu.obs roofline` replays ('' = no "
+                        "event log; the profiler still runs and the "
+                        "measured_* columns still populate)")
     p.add_argument("--reps", type=int, default=5,
                    help="timed repetitions (best-of is reported)")
     p.add_argument("--layers", type=int, default=2)
@@ -231,6 +239,29 @@ def main() -> None:
 
     def _predict(fn, *abstract_args, donate_argnums=()):
         return _costs(fn, *abstract_args, donate_argnums=donate_argnums).peak_bytes
+
+    # Measured side of the roofline (obs/profile.py): each scheduler sweep
+    # row runs with a FRESH telemetry bundle + profiler (its own registry),
+    # so measured_step_p50_ms is that row's own number rather than an
+    # aggregate across variants; every bundle appends its final
+    # metrics.snapshot to the same --metrics_jsonl, which is exactly the
+    # episode `python -m transformer_tpu.obs roofline` joins against the
+    # cost model.
+    from transformer_tpu.obs import EventLog, Telemetry
+    from transformer_tpu.obs.profile import roofline_ratio
+
+    def _sweep_telemetry():
+        events = EventLog(args.metrics_jsonl) if args.metrics_jsonl else None
+        tel = Telemetry(events=events, interval=1e9)
+        tel.arm_profiler()
+        return tel
+
+    def _measured_step(tel, program):
+        """Pull ``program``'s measured row from the bundle's profiler, then
+        close the bundle (forcing the final metrics.snapshot flush)."""
+        row = tel.profiler.summary().get(program) or {}
+        tel.close()
+        return row
 
     decode_peak = _predict(
         lambda p, t, c, pos: transformer_decode_step(
@@ -398,10 +429,11 @@ def main() -> None:
         )
         answers = {}
         for layout in kv_layouts:
+            ltel = _sweep_telemetry()
             sched = ContinuousScheduler(
                 params, cfg, ltok, num_slots=slots,
                 prefill_chunk=args.chunk, kv_layout=layout, kv_block=block,
-                max_total=serve_total,
+                max_total=serve_total, telemetry=ltel,
             )
             t0 = time.perf_counter()
             out = sched.run([dict(r) for r in lreqs])
@@ -414,7 +446,7 @@ def main() -> None:
             if layout == "paged":
                 pool_blocks = 1 + slots * slot_blocks
                 kv = kv_pool_bytes(cfg, serve_total, slots, pool_blocks, block)
-                peak = _predict(
+                raw = _costs(
                     lambda p, c, tb, ix, t: _pool_step_paged.__wrapped__(
                         p, c, tb, ix, t, cfg, block, serve_total
                     ),
@@ -432,7 +464,7 @@ def main() -> None:
                 max_slots = int(budget_bytes // (used_blocks * block_bytes))
                 bytes_per_slot = int(used_blocks * block_bytes)
             else:
-                peak = _predict(
+                raw = _costs(
                     lambda p, c, t: _pool_step.__wrapped__(p, c, t, cfg),
                     params,
                     abstract_pool_caches(cfg, slots, serve_total),
@@ -441,11 +473,31 @@ def main() -> None:
                 )
                 max_slots = int(budget_bytes // dense_kv["bytes_per_slot"])
                 bytes_per_slot = dense_kv["bytes_per_slot"]
+            step_prog = (
+                "serve.pool_step_paged" if layout == "paged"
+                else "serve.pool_step"
+            )
+            measured = _measured_step(ltel, step_prog)
+            step_p50_ms = measured.get("p50_ms")
+            step_ratio = roofline_ratio(
+                raw.bytes_moved, measured.get("p50_s") or 0.0
+            )
+            assert step_p50_ms, (
+                f"kv_layout={layout}: no measured {step_prog} dispatches — "
+                "the profiler should have clocked every pool step"
+            )
+            assert step_ratio, (
+                f"kv_layout={layout}: roofline_ratio missing "
+                f"(bytes_moved={raw.bytes_moved}, measured={measured})"
+            )
             layout_rows.append({
                 "kv_layout": layout,
                 "tokens_per_sec": round(new_tokens / wall, 1) if wall else None,
                 "wall_s": round(wall, 3),
-                "predicted_peak_bytes": peak,
+                "predicted_peak_bytes": raw.peak_bytes,
+                "predicted_bytes_moved": raw.bytes_moved,
+                "measured_step_p50_ms": step_p50_ms,
+                "roofline_ratio": step_ratio,
                 "kv_bytes_per_slot": bytes_per_slot,
                 "max_slots_in_budget": max_slots,
                 "budget_bytes": int(budget_bytes),
@@ -527,10 +579,12 @@ def main() -> None:
             vparams = transformer_init(jax.random.PRNGKey(0), vcfg)
             vanswers = {}
             for kernel in kernels:
+                ktel = _sweep_telemetry()
                 sched = ContinuousScheduler(
                     vparams, vcfg, ktok, num_slots=kslots,
                     prefill_chunk=args.chunk, kv_layout="paged",
                     kv_block=kblock, max_total=ktotal, decode_kernel=kernel,
+                    telemetry=ktel,
                 )
                 t0 = time.perf_counter()
                 out = sched.run([dict(r) for r in kreqs])
@@ -578,6 +632,22 @@ def main() -> None:
                         jnp.zeros((kslots,), jnp.int32),
                         donate_argnums=(1,),
                     )
+                step_prog = (
+                    "serve.pool_step_paged_flash" if kernel == "paged_flash"
+                    else "serve.pool_step_paged"
+                )
+                measured = _measured_step(ktel, step_prog)
+                step_p50_ms = measured.get("p50_ms")
+                step_ratio = roofline_ratio(
+                    raw.bytes_moved, measured.get("p50_s") or 0.0
+                )
+                assert step_p50_ms, (
+                    f"{vname}/{kernel}: no measured {step_prog} dispatches"
+                )
+                assert step_ratio, (
+                    f"{vname}/{kernel}: roofline_ratio missing "
+                    f"(bytes_moved={raw.bytes_moved}, measured={measured})"
+                )
                 kernel_rows.append({
                     "cache_variant": vname,
                     "decode_kernel": kernel,
@@ -587,6 +657,8 @@ def main() -> None:
                     "wall_s": round(wall, 3),
                     "predicted_bytes_moved": raw.bytes_moved,
                     "predicted_peak_bytes": raw.peak_bytes,
+                    "measured_step_p50_ms": step_p50_ms,
+                    "roofline_ratio": step_ratio,
                     "predicted_vmem_bytes": (
                         max(kernel_vmem.values()) if kernel_vmem else 0
                     ),
@@ -647,6 +719,8 @@ def main() -> None:
                 "predicted_bytes_moved": r["predicted_bytes_moved"],
                 "predicted_peak_bytes": r["predicted_peak_bytes"],
                 "predicted_vmem_bytes": r["predicted_vmem_bytes"],
+                "measured_step_p50_ms": r["measured_step_p50_ms"],
+                "roofline_ratio": r["roofline_ratio"],
                 "device": f"{dev.platform}:{dev.device_kind}",
                 "vs_baseline": None,
             })
@@ -678,6 +752,9 @@ def main() -> None:
                 "tokens_per_sec": r["tokens_per_sec"],
                 "kv_bytes_per_slot": r["kv_bytes_per_slot"],
                 "predicted_peak_bytes": r["predicted_peak_bytes"],
+                "predicted_bytes_moved": r["predicted_bytes_moved"],
+                "measured_step_p50_ms": r["measured_step_p50_ms"],
+                "roofline_ratio": r["roofline_ratio"],
                 "device": f"{dev.platform}:{dev.device_kind}",
                 "vs_baseline": None,
             })

@@ -80,6 +80,62 @@ def _p(q: list[float], frac: float) -> float:
     return s[min(len(s) - 1, int(frac * len(s)))]
 
 
+_STEP_COST_CACHE: dict = {}
+
+
+def _pool_step_bytes(kv_layout: str, slots: int, kv_block: int) -> int:
+    """Cost-model bytes_moved for the replicas' batched pool step — the
+    program the workers' profilers clock — on the SPEC model at replica
+    defaults (max_total = max_position + 1, full paged provisioning), so
+    the measured p50 and the prediction describe the same dispatch."""
+    key = (kv_layout, slots, kv_block)
+    if key in _STEP_COST_CACHE:
+        return _STEP_COST_CACHE[key]
+    import jax.numpy as jnp
+
+    from transformer_tpu.analysis.costs import program_costs
+    from transformer_tpu.serve.replica import build_model_from_spec
+
+    params, cfg, _ = build_model_from_spec(SPEC)
+    max_total = cfg.max_position + 1
+    if kv_layout == "paged":
+        from transformer_tpu.serve.scheduler import (
+            _pool_step_paged,
+            abstract_paged_pool,
+        )
+
+        slot_blocks = -(-max_total // kv_block)
+        pool_blocks = 1 + slots * slot_blocks
+        raw = program_costs(
+            "bench",
+            lambda p, c, tb, ix, t: _pool_step_paged.__wrapped__(
+                p, c, tb, ix, t, cfg, kv_block, max_total
+            ),
+            params,
+            *abstract_paged_pool(
+                cfg, slots, max_total, pool_blocks, kv_block
+            ),
+            jnp.zeros((slots,), jnp.int32),
+            donate_argnums=(1,),
+        )
+    else:
+        from transformer_tpu.serve.scheduler import (
+            _pool_step,
+            abstract_pool_caches,
+        )
+
+        raw = program_costs(
+            "bench",
+            lambda p, c, t: _pool_step.__wrapped__(p, c, t, cfg),
+            params,
+            abstract_pool_caches(cfg, slots, max_total),
+            jnp.zeros((slots,), jnp.int32),
+            donate_argnums=(1,),
+        )
+    _STEP_COST_CACHE[key] = raw.bytes_moved
+    return raw.bytes_moved
+
+
 def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
     from transformer_tpu.serve.replica import build_model_from_spec
     from transformer_tpu.serve.router import ReplicaProcess, Router
@@ -93,7 +149,19 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
         "--kv_layout", getattr(args, "kv_layout", "dense"),
         "--heartbeat_ms", "100",
     ]
-    links = [ReplicaProcess.spawn(i, worker) for i in range(n_replicas)]
+    # Per-replica metrics JSONL: arms each worker's profiler (+ flight
+    # recorder), so the shutdown report carries the measured per-program
+    # perf rows the roofline columns join against.
+    obs_dir = tempfile.mkdtemp(prefix="router_bench_obs_")
+    links = [
+        ReplicaProcess.spawn(
+            i,
+            worker + [
+                "--metrics_jsonl", os.path.join(obs_dir, f"replica{i}.jsonl"),
+            ],
+        )
+        for i in range(n_replicas)
+    ]
     router = Router(
         links, encode=tok.encode, bos_id=tok.bos_id,
         affinity_block=args.prefix_block, heartbeat_timeout_s=10.0,
@@ -149,6 +217,25 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
             "host_restored_tokens": st.get("host_restored_tokens"),
             "killed": link.dead,
         }
+    # Measured-vs-predicted roofline for the batched pool step, from the
+    # workers' final perf reports (median p50 across the surviving
+    # replicas) joined against the cost model's bytes_moved.
+    from transformer_tpu.obs.profile import roofline_ratio
+
+    step_prog = (
+        "serve.pool_step_paged" if args.kv_layout == "paged"
+        else "serve.pool_step"
+    )
+    step_p50s = []
+    for link in router.links:
+        perf = (link.final_perf or {}).get(step_prog) or {}
+        per_replica[link.name]["measured_step_p50_ms"] = perf.get("p50_ms")
+        if perf.get("p50_s"):
+            step_p50s.append(perf["p50_s"])
+    step_bytes = _pool_step_bytes(args.kv_layout, args.slots, args.prefix_block)
+    step_p50_s = (
+        sorted(step_p50s)[len(step_p50s) // 2] if step_p50s else None
+    )
     router.shutdown()
     return {
         "replicas": n_replicas,
@@ -162,6 +249,11 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
         "redispatch_count": router.stats["redispatched"],
         "failovers": router.stats["failovers"],
         "killed_one": killed,
+        "predicted_bytes_moved": step_bytes,
+        "measured_step_p50_ms": (
+            round(step_p50_s * 1e3, 6) if step_p50_s else None
+        ),
+        "roofline_ratio": roofline_ratio(step_bytes, step_p50_s or 0.0),
         "per_replica": per_replica,
     }
 
@@ -183,10 +275,21 @@ def run_heal(args, spec_path: str) -> dict:
         "--heartbeat_ms", "100",
     ]
     n_replicas = 2
-    links = [ReplicaProcess.spawn(i, list(worker)) for i in range(n_replicas)]
+    # Per-replica metrics JSONL: the victim's flight recorder autodumps
+    # next to it, which is what the supervisor's postmortem capture
+    # salvages after the SIGKILL (respawns for the same index reuse the
+    # path — the event log appends, the dump is rewritten).
+    obs_dir = tempfile.mkdtemp(prefix="router_heal_obs_")
+
+    def _argv(i):
+        return list(worker) + [
+            "--metrics_jsonl", os.path.join(obs_dir, f"replica{i}.jsonl"),
+        ]
+
+    links = [ReplicaProcess.spawn(i, _argv(i)) for i in range(n_replicas)]
 
     def spawn(index, name, role):
-        return ReplicaProcess.spawn(index, list(worker), role=role, name=name)
+        return ReplicaProcess.spawn(index, _argv(index), role=role, name=name)
 
     sup = Supervisor(spawn, backoff_ms=50.0)
     router = Router(
@@ -236,6 +339,7 @@ def run_heal(args, spec_path: str) -> dict:
         "served_during_gap": gap_served,
         "warmed_tokens": sup.stats["warmed_tokens"],
         "respawns": sup.stats["respawns"],
+        "postmortems": sup.stats["postmortems"],
         "redispatch_count": router.stats["redispatched"],
     }
 
@@ -410,6 +514,12 @@ def main() -> None:
             assert result["answered"] == result["requests"], (
                 "router lost requests"
             )
+            assert result["measured_step_p50_ms"], (
+                f"no measured pool-step p50 from the fleet: {result}"
+            )
+            assert result["roofline_ratio"], (
+                f"roofline_ratio missing: {result}"
+            )
             hit_rates = [
                 r["prefix_hit_rate"]
                 for r in result["per_replica"].values()
@@ -439,6 +549,9 @@ def main() -> None:
                 "prefix_alias_tokens": alias_tokens,
                 "redispatch_count": result["redispatch_count"],
                 "failovers": result["failovers"],
+                "predicted_bytes_moved": result["predicted_bytes_moved"],
+                "measured_step_p50_ms": result["measured_step_p50_ms"],
+                "roofline_ratio": result["roofline_ratio"],
                 "device": device,
                 "vs_baseline": None,
             }))
@@ -464,6 +577,9 @@ def main() -> None:
                 "served_during_gap": result["served_during_gap"],
                 "warmed_tokens": result["warmed_tokens"],
                 "redispatch_count": result["redispatch_count"],
+                # Supervisor-captured crash forensics: how many dead
+                # replicas left a salvageable flight record this soak.
+                "postmortems": result["postmortems"],
                 "device": device,
                 "vs_baseline": None,
             }))

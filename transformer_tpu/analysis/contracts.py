@@ -542,10 +542,13 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
     byte-identical jaxprs. The instrumented twin is built with the real
     wrappers the telemetry-enabled Trainer installs around its step
     dispatches — ``obs.telemetry.timed_call`` feeding a live registry
-    histogram + counter, COMPOSED with ``obs.trace.traced_call`` opening a
-    real span on a live tracer (the ``--trace`` stack, spans emitted to a
-    real in-memory EventLog); the serving pool step, slot prefill, and
-    speculative verify programs are traced through the same wrappers. Any
+    histogram + counter, COMPOSED with ``obs.profile.profile_call``
+    recording into a live ProgramProfiler (the roofline sentinel) and
+    ``obs.trace.traced_call`` opening a real span on a live tracer (the
+    ``--trace`` stack, spans emitted through a live FlightRecorder tap
+    into a real in-memory EventLog); the serving pool step, slot prefill,
+    and speculative verify programs are traced through the same wrappers.
+    Any
     future 'improvement' that lets a recorded value flow back into the
     computation — or adds so much as a ``convert_element_type`` to the
     trace — fails here, rounds before a byte-identity serving test would
@@ -557,6 +560,8 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
 
     from transformer_tpu.obs import MetricsRegistry
     from transformer_tpu.obs.events import EventLog
+    from transformer_tpu.obs.flight import FlightRecorder
+    from transformer_tpu.obs.profile import ProgramProfiler, profile_call
     from transformer_tpu.obs.telemetry import timed_call
     from transformer_tpu.obs.trace import Tracer, traced_call
     from transformer_tpu.train.state import TrainState, make_optimizer
@@ -566,7 +571,12 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
 
     reg = MetricsRegistry()
     span_sink = io.StringIO()
-    tracer = Tracer(EventLog(span_sink).emit)
+    # Both PR-18 subsystems armed exactly as production arms them: the
+    # flight recorder taps the tracer's emit path (every span rides the
+    # ring), the profiler records through the registry.
+    flight = FlightRecorder(None, capacity=64)
+    tracer = Tracer(flight.tap(EventLog(span_sink).emit))
+    profiler = ProgramProfiler(registry=reg)
 
     def canon(jaxpr) -> str:
         # custom_jvp equations print closure thunks with their memory
@@ -577,10 +587,12 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
 
     def twins(fn):
         # The exact production composition: traced_call outermost around
-        # timed_call (trainer._wrap_steps_for_dispatch_timing order).
+        # profile_call around timed_call
+        # (trainer._wrap_steps_for_dispatch_timing order).
         wrapped = timed_call(
             fn, reg.histogram("contract_seconds"), reg.counter("contract_total")
         )
+        wrapped = profile_call(wrapped, profiler, "contract.step")
         wrapped = traced_call(wrapped, tracer, "contract.step")
         return fn, wrapped
 
@@ -669,7 +681,17 @@ def check_telemetry_inert(cfg: ModelConfig) -> str:
     assert "trace.span" in span_sink.getvalue(), (
         "the tracer's spans never reached the event log"
     )
-    return f"jaxpr-identical twins (timed+traced): {', '.join(checked)}"
+    assert profiler.stats["records"] >= len(checked), (
+        "the profiled twin never recorded — the profiler side of the "
+        "contract is vacuous"
+    )
+    assert flight.depth() > 0 and flight.dump("request")["spans"], (
+        "the tracer's spans never rode the flight-recorder ring"
+    )
+    return (
+        "jaxpr-identical twins (timed+profiled+traced, flight armed): "
+        f"{', '.join(checked)}"
+    )
 
 
 def check_fault_plane_inert(cfg: ModelConfig) -> str:

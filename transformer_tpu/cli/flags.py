@@ -83,6 +83,19 @@ def define_metrics_flags() -> None:
         "`python -m transformer_tpu.obs trace <file> --out trace.json` and "
         "load in chrome://tracing / Perfetto. Answers and compiled programs "
         "are unaffected (contract-checked)")
+    flags.DEFINE_boolean(
+        "profile_programs", True,
+        "per-program dispatch profiler (obs/profile.py): clock every canned "
+        "jitted program into perf_seconds_* histograms and roofline/drift "
+        "gauges, sentinel measured-vs-banked drift (perf.drift events). "
+        "Jaxpr-inert (contract-checked); report with "
+        "`python -m transformer_tpu.obs roofline <file>`")
+    flags.DEFINE_boolean(
+        "flight_recorder", True,
+        "always-on bounded flight recorder (obs/flight.py): keep the last "
+        "seconds of events/spans/snapshots in memory and dump them to "
+        "<metrics_jsonl>.flight.json on signal/close plus a periodic "
+        "autodump (crash durability). Needs --metrics_jsonl")
 
 
 def define_flags() -> None:
@@ -405,9 +418,18 @@ def flags_to_telemetry():
         interval=FLAGS.metrics_interval,
         trace=FLAGS.trace and events is not None,
     )
+    if FLAGS.profile_programs:
+        telemetry.arm_profiler()
+    if FLAGS.flight_recorder and FLAGS.metrics_jsonl:
+        from transformer_tpu.obs.flight import flight_path_for
+
+        recorder = telemetry.arm_flight(
+            flight_path_for(FLAGS.metrics_jsonl), autodump_s=2.0
+        )
+        recorder.install_signal_handlers()
     if FLAGS.metrics_port:
         port = telemetry.start_prometheus_server(FLAGS.metrics_port)
-        logging.info("Prometheus /metrics on port %d", port)
+        logging.info("Prometheus /metrics (+ /healthz) on port %d", port)
     return telemetry
 
 

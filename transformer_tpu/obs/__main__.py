@@ -1,4 +1,5 @@
-"""``python -m transformer_tpu.obs <summarize|trace|slo>`` — telemetry CLI.
+"""``python -m transformer_tpu.obs <summarize|trace|slo|roofline|postmortem>``
+— telemetry CLI.
 
 - ``summarize`` aggregates a structured event log (docs/OBSERVABILITY.md
   schema) into the operator-facing numbers: tokens/s, step p50/p95, slot
@@ -10,6 +11,16 @@
   ui.perfetto.dev; one lane per serve slot plus scheduler/intake/train.
 - ``slo`` evaluates declarative SLOs (``obs/slo.py``) as multi-window burn
   rates over the same log.
+- ``roofline`` joins an episode's measured per-program dispatch histograms
+  (``obs/profile.py``, the ``perf_seconds_*`` stream) against cost-model
+  predictions (``--costs`` = an ``analysis costs --format=json`` document)
+  and the banked baseline: tokens/s, effective bytes/s, roofline ratio,
+  and drift verdicts per program. ``--check`` exits 1 on a banked-band
+  breach; ``--update`` re-banks the measured p50s (the pass → perturb →
+  fail → ``--update`` → pass workflow the analysis families use).
+- ``postmortem`` reconstructs a fleet's last seconds from any mix of
+  event logs, ``*.flight.json`` flight-recorder dumps, and the flight
+  records the Supervisor embedded in ``route.postmortem`` events.
 
 All three accept MULTIPLE jsonl files (``--merge``): events are tagged with
 their source and clock-aligned via per-file skew estimation
@@ -26,6 +37,15 @@ import json
 import sys
 
 from transformer_tpu.obs.merge import filter_events, merge_events, parse_duration
+from transformer_tpu.obs.profile import (
+    BASELINE_PATH,
+    band_breaches,
+    load_baseline,
+    measured_from_events,
+    predictions_by_program,
+    roofline_report,
+    write_baseline,
+)
 from transformer_tpu.obs.quantiles import StreamingHistogram
 
 
@@ -442,6 +462,16 @@ def summarize_events(events: list[dict]) -> dict:
         if entry:
             report.setdefault("train", {})["predicted"] = entry
 
+    # ---- perf: measured programs vs the cost model (obs/profile.py) ------
+    # The profiler's per-program histograms ride metrics.snapshot; join
+    # them against the banked baseline's frozen predictions. Tolerant when
+    # either side is absent: no profiler stream -> no section; an unbanked
+    # program rows without the bytes/drift columns. `obs roofline` is the
+    # full report (this section skips the --costs join).
+    perf = roofline_report(events)
+    if perf.get("programs"):
+        report["perf"] = perf
+
     # ---- bench attribution ----------------------------------------------
     bench = [e for e in events if str(e.get("kind", "")).startswith("bench.")]
     if bench:
@@ -695,6 +725,26 @@ def render_text(report: dict) -> str:
             if pred.get("measured_over_predicted") is not None:
                 line += f" (measured/predicted {pred['measured_over_predicted']}x)"
             lines.append(line)
+    perf = report.get("perf")
+    if perf:
+        lines.append(
+            f"perf: {len(perf['programs'])} measured program(s) "
+            "(`obs roofline` renders the full join)"
+        )
+        for r in perf["programs"]:
+            line = (
+                f"  {r['program']}: p50 {r['p50_ms']:.3f}ms "
+                f"over {r['dispatches']} dispatches"
+            )
+            if r.get("measured_tokens_per_s"):
+                line += f", {r['measured_tokens_per_s']} tokens/s"
+            if r.get("roofline_ratio") is not None:
+                line += f", roofline {r['roofline_ratio']}"
+            if r.get("drift") is not None:
+                line += f", drift {r['drift']}x" + (
+                    "" if r.get("in_band", True) else " OUT OF BAND"
+                )
+            lines.append(line)
     bench = report.get("bench")
     if bench:
         lines.append(
@@ -726,6 +776,157 @@ def render_text(report: dict) -> str:
         lines.append("sources: " + "; ".join(parts))
     if len(lines) == 1:
         lines.append("no serve/train/bench telemetry kinds found")
+    return "\n".join(lines)
+
+
+def render_roofline_text(report: dict) -> str:
+    rows = report.get("programs", [])
+    lines = [
+        f"{len(rows)} measured program(s); roofline peak "
+        f"{report.get('peak_bytes_per_s', 0):.4g} B/s"
+    ]
+    for r in rows:
+        line = (
+            f"  {r['program']}: p50 {r['p50_ms']:.3f}ms "
+            f"p95 {r['p95_ms']:.3f}ms over {r['dispatches']} dispatches"
+        )
+        if r.get("measured_tokens_per_s"):
+            line += f", {r['measured_tokens_per_s']} tokens/s"
+        if r.get("predicted_bytes_moved"):
+            line += (
+                f"; predicted {r['predicted_bytes_moved']}B moved -> "
+                f"{r['effective_bytes_per_s']:.4g} B/s effective, "
+                f"roofline {r['roofline_ratio']}"
+            )
+        if r.get("measured_over_predicted_tokens") is not None:
+            line += (
+                f"; measured/predicted tokens/s "
+                f"{r['measured_over_predicted_tokens']}x"
+            )
+        if r.get("drift") is not None:
+            verdict = "in band" if r.get("in_band") else "OUT OF BAND"
+            line += f"; drift {r['drift']}x {r.get('band')} {verdict}"
+        lines.append(line)
+    if len(lines) == 1:
+        lines.append(
+            "no perf_seconds_* histograms found (profiler not armed, or "
+            "no metrics.snapshot flushed?)"
+        )
+    return "\n".join(lines)
+
+
+def _flight_doc(path: str) -> dict | None:
+    """json.load the whole file: a flight dump is ONE dict carrying an
+    ``events`` ring and no top-level ``kind`` — anything else (a JSONL
+    log, a torn file) is not a dump and falls back to the merge path."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (
+        isinstance(doc, dict)
+        and isinstance(doc.get("events"), list)
+        and "kind" not in doc
+    ):
+        return doc
+    return None
+
+
+def postmortem_report(
+    events: list[dict], flights: list[dict], info: dict | None = None
+) -> dict:
+    """Fuse merged event logs, standalone flight dumps, and the records
+    embedded in ``route.postmortem`` events into one fleet timeline plus
+    a per-victim postmortem table (their final ``serve.request`` spans
+    are the rows an incident review reads first)."""
+    timeline = [dict(e) for e in events]
+    postmortems: list[dict] = []
+
+    def ingest(record: dict, replica: str, origin: str) -> None:
+        ring_events = [
+            e for e in (record.get("events") or []) if isinstance(e, dict)
+        ]
+        ring_spans = [
+            s for s in (record.get("spans") or []) if isinstance(s, dict)
+        ]
+        for entry in ring_events + ring_spans:
+            tagged = dict(entry)
+            tagged["source"] = f"postmortem:{replica}"
+            timeline.append(tagged)
+        reqs = [e for e in ring_events if e.get("kind") == "serve.request"]
+        postmortems.append({
+            "replica": replica,
+            "origin": origin,
+            "reason": record.get("reason"),
+            "ts": record.get("ts"),
+            "pid": record.get("pid"),
+            "events": len(ring_events),
+            "spans": len(ring_spans),
+            "final_requests": reqs[-5:],
+        })
+
+    for e in events:
+        if e.get("kind") == "route.postmortem" and isinstance(
+            e.get("record"), dict
+        ):
+            ingest(e["record"], str(e.get("replica")), str(e.get("origin")))
+    for doc in flights:
+        ingest(doc, str(doc.get("source") or doc.get("pid") or "?"), "file")
+
+    timeline = [t for t in timeline if isinstance(t.get("ts"), (int, float))]
+    timeline.sort(key=lambda t: t["ts"])
+    report = {
+        "events": len(events),
+        "flight_files": len(flights),
+        "postmortems": postmortems,
+        "timeline": timeline[-80:],
+    }
+    if info:
+        report.update(info)
+    return report
+
+
+def render_postmortem_text(report: dict) -> str:
+    pms = report.get("postmortems", [])
+    lines = [
+        f"{len(pms)} postmortem(s) over {report.get('events', 0)} log "
+        f"event(s) + {report.get('flight_files', 0)} flight dump file(s)"
+    ]
+    for p in pms:
+        lines.append(
+            f"  {p['replica']} [{p['origin']}] reason={p.get('reason')} "
+            f"pid={p.get('pid')}: {p['events']} events, {p['spans']} spans, "
+            f"{len(p['final_requests'])} final request(s)"
+        )
+        for r in p["final_requests"]:
+            total = r.get("total_s")
+            lines.append(
+                f"    request order={r.get('order')} "
+                f"tokens={r.get('new_tokens')}"
+                + (f" total={_fmt_s(total)}"
+                   if isinstance(total, (int, float)) else "")
+                + (" ERROR" if "error" in r else "")
+            )
+    tail = report.get("timeline", [])[-15:]
+    if tail:
+        lines.append("last seconds:")
+        for t in tail:
+            src = t.get("source")
+            lines.append(
+                f"  {t['ts']:.3f} "
+                + (f"[{src}] " if src else "")
+                + str(t.get("kind"))
+            )
+    sources = report.get("sources")
+    if sources:
+        parts = [
+            f"{name} ({s['events']} events"
+            + (f", skew {s['skew_s']:+g}s" if s.get("skew_s") else "")
+            + ")"
+            for name, s in sorted(sources.items())
+        ]
+        lines.append("sources: " + "; ".join(parts))
     return "\n".join(lines)
 
 
@@ -806,7 +1007,78 @@ def main(argv: list[str] | None = None) -> int:
     p_slo.add_argument(
         "--format", choices=("text", "json"), default="text",
     )
+    p_roof = sub.add_parser(
+        "roofline",
+        help="measured-vs-predicted per-program report from the profiler "
+        "stream (perf_seconds_* histograms in metrics.snapshot)",
+    )
+    _add_common_args(p_roof)
+    p_roof.add_argument(
+        "--costs", default=None, metavar="JSON",
+        help="`analysis costs --format=json` document to join predictions "
+        "from (without it, the banked baseline's frozen predictions apply)",
+    )
+    p_roof.add_argument(
+        "--baseline", default=BASELINE_PATH,
+        help="banked roofline baseline (default: the checked-in "
+        "obs/roofline_baseline.json)",
+    )
+    p_roof.add_argument(
+        "--update", action="store_true",
+        help="re-bank the episode's measured p50s into --baseline "
+        "(absolute times are per-host: run on the box that enforces "
+        "the band)",
+    )
+    p_roof.add_argument(
+        "--check", action="store_true",
+        help="exit 1 when any banked program's measured p50 left its band",
+    )
+    p_roof.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    p_pm = sub.add_parser(
+        "postmortem",
+        help="reconstruct the fleet's last seconds from event logs, "
+        "*.flight.json dumps, and route.postmortem records",
+    )
+    _add_common_args(p_pm)
+    p_pm.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
     args = parser.parse_args(argv)
+
+    if args.cmd == "postmortem":
+        # Inputs are a MIX of flight dumps (whole-file JSON) and JSONL
+        # logs — sniff each before the merge machinery sees it.
+        flights, jsonls = [], []
+        for path in args.jsonl:
+            doc = _flight_doc(path)
+            if doc is not None:
+                doc.setdefault("source", path)
+                flights.append(doc)
+            else:
+                jsonls.append(path)
+        events, info = [], {}
+        if jsonls:
+            try:
+                events, info = merge_events(jsonls, align=not args.no_align)
+            except OSError as e:
+                print(f"cannot read {', '.join(jsonls)}: {e}", file=sys.stderr)
+                return 2
+            if args.last is not None:
+                events = filter_events(events, last=parse_duration(args.last))
+            if args.since is not None:
+                events = filter_events(events, since=args.since)
+        report = postmortem_report(
+            events, flights,
+            info if (len(jsonls) > 1 or args.merge) else {},
+        )
+        if args.format == "json":
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print(render_postmortem_text(report))
+        return 0
+
     try:
         events, info = _load(args)
     except OSError as e:
@@ -823,6 +1095,59 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(report, indent=2, sort_keys=True))
         else:
             print(render_text(report))
+        return 0
+
+    if args.cmd == "roofline":
+        costs_doc = None
+        if args.costs:
+            try:
+                with open(args.costs, encoding="utf-8") as f:
+                    costs_doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"cannot read --costs {args.costs}: {e}", file=sys.stderr)
+                return 2
+        if args.update:
+            measured = measured_from_events(events)
+            if not measured:
+                print(
+                    "no perf_seconds_* histograms in the episode; "
+                    "nothing to bank",
+                    file=sys.stderr,
+                )
+                return 2
+            prior = load_baseline(args.baseline)
+            # Predictions to freeze next to the banked p50s: a --costs
+            # document when given, else whatever the prior bank froze.
+            preds = (
+                predictions_by_program(costs_doc)
+                if costs_doc else dict(prior.get("programs") or {})
+            )
+            doc = write_baseline(
+                args.baseline, measured, predictions=preds,
+                peak_bytes_per_s=prior.get("peak_bytes_per_s"),
+            )
+            print(
+                f"banked {len(doc['programs'])} program(s) -> {args.baseline}"
+            )
+            return 0
+        report = roofline_report(
+            events, costs=costs_doc, baseline=load_baseline(args.baseline)
+        )
+        report.update(info)
+        if args.format == "json":
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print(render_roofline_text(report))
+        if args.check:
+            breaches = band_breaches(report)
+            if breaches:
+                for r in breaches:
+                    print(
+                        f"BAND BREACH {r['program']}: drift {r['drift']}x "
+                        f"outside {r['band']}",
+                        file=sys.stderr,
+                    )
+                return 1
         return 0
 
     if args.cmd == "trace":

@@ -55,11 +55,30 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
   ``weight_version``, so ``obs summarize --merge`` renders the upgrade
   section (per-version request share, canary window, rollbacks,
   time-to-upgrade) from the same stream.
+- ``route.postmortem`` — emitted by the Supervisor when it captures a
+  dead or respawning replica's final flight record (``obs/flight.py``)
+  before recycling the slot: ``replica``, ``origin`` (``wire`` for a
+  live ``dump`` reply, ``file`` for an on-disk autodump salvaged after a
+  SIGKILL), and the full ``record`` (events/spans/snapshots rings).
+  ``python -m transformer_tpu.obs postmortem`` reconstructs the fleet's
+  last seconds from these.
+- ``flight.dump`` — one per non-automatic flight-recorder dump
+  (signal / explicit request / clean close; periodic autodumps stay
+  silent): ``reason``, ``path``, and ring sizes.
+- ``perf.drift`` — one per measured-vs-banked breach-state transition
+  (``obs/profile.py``): ``program``, measured-over-banked p50 ``ratio``,
+  the ``band``, both p50s, and ``breached``. Same transition-only
+  discipline as ``slo.burn``.
 - ``metrics.snapshot`` — periodic full registry dump (histograms as
   count/sum/min/max/p50/p95/p99).
 - ``bench.relay_probe`` / ``bench.fallback_row`` / ``bench.attempt`` —
   bench-infra attribution (bench.py), so a flaky relay is diagnosable from
   the log after the fact.
+
+The machine-readable mirror of this list is :data:`EVENT_CATALOGUE`
+below; a tier-1 AST sweep (tests/test_perf_observatory.py) fails if any
+``emit`` call site in the package uses a kind missing from the catalogue
+or from docs/OBSERVABILITY.md — the catalogue cannot silently rot.
 
 Threading contract (machine-checked: the TPA1xx concurrency rules lint
 this module, ``analysis/schedules.py eventlog_writers`` explores
@@ -91,6 +110,48 @@ import time
 # subclasses OSError on purpose: it flows through the same handler a full
 # disk would.
 fault_hook = None
+
+#: Every event kind this package emits, with a one-line meaning. The
+#: catalogue drift gate (tests/test_perf_observatory.py) AST-sweeps all
+#: literal ``emit(kind, ...)`` call sites and asserts each kind appears
+#: here AND in docs/OBSERVABILITY.md — add the entry (and the doc schema)
+#: in the same change that adds an emit site.
+EVENT_CATALOGUE = {
+    "bench.attempt": "bench-infra: one per relay attempt (bench.py rows)",
+    "bench.fallback_row": "bench-infra: CPU-fallback row attribution",
+    "bench.no_value": "bench-infra: a probe that produced no value",
+    "bench.relay_probe": "bench-infra: relay liveness probe outcome",
+    "ckpt.fallback": "trainer restored an older checkpoint after a bad one",
+    "flight.dump": "non-automatic flight-recorder dump (signal/request/close)",
+    "metrics.snapshot": "periodic full metrics-registry dump",
+    "perf.drift": "measured p50 left (or re-entered) its banked band",
+    "route.answered": "HA journal: delivery mark for an accepted order",
+    "route.canary": "canary slice lifecycle (started/promoted)",
+    "route.dispatch": "router picked a replica for one request",
+    "route.failover": "replica failure with victim orders re-dispatched",
+    "route.hb": "HA journal: periodic primary liveness beacon",
+    "route.intake": "HA journal: one replayable accepted-order record",
+    "route.postmortem": "supervisor captured a dead replica's flight record",
+    "route.retire": "supervised drain-and-retire completed",
+    "route.revive": "half-open breaker revived a heartbeat-timeout victim",
+    "route.scale": "autoscaling decision with its burn-rate evidence",
+    "route.spawn": "replica (re)spawn admitted (or crash loop gave up)",
+    "route.takeover": "standby adopted the fleet under a new epoch",
+    "route.upgrade": "live-weights rollout lifecycle (by phase)",
+    "schedules.test": "interleaving explorer's synthetic event (self-test)",
+    "serve.batch": "one grouped-path decode batch",
+    "serve.breaker": "admission circuit-breaker state transition",
+    "serve.request": "one finished/errored request with span breakdown",
+    "serve.retry": "one transient-admission retry",
+    "slo.burn": "SLO breach-state transition with window burn rates",
+    "trace.span": "one closed tracing span",
+    "train.compile": "jit compile-cache accounting at an epoch boundary",
+    "train.eval": "one eval pass result",
+    "train.memory": "device memory stats at an epoch boundary",
+    "train.predicted": "cost-model prediction snapshot for the train step",
+    "train.preempt": "preemption checkpoint written on signal",
+    "train.window": "one closed StepTimer throughput window",
+}
 
 
 class EventLog:

@@ -83,17 +83,52 @@ class Telemetry:
         # flush must ALWAYS run.
         self._last_flush = float("-inf")
         self._server = None
+        self._t0 = time.time()
         self.tracer = None
+        # Armed on demand (arm_profiler / arm_flight): the per-program
+        # dispatch profiler (obs/profile.py) and the always-on flight
+        # recorder (obs/flight.py). None keeps both surfaces free.
+        self.profiler = None
+        self.flight = None
         if trace:
             from transformer_tpu.obs.trace import Tracer
 
             self.tracer = Tracer(self.emit)
+
+    # ---- optional subsystems ---------------------------------------------
+
+    def arm_profiler(self, baseline: dict | None = None):
+        """Attach a :class:`~transformer_tpu.obs.profile.ProgramProfiler`
+        bound to this bundle's registry and emit (perf_* metrics ride the
+        snapshot/prom sinks; perf.drift events ride the log)."""
+        from transformer_tpu.obs.profile import ProgramProfiler
+
+        self.profiler = ProgramProfiler(
+            registry=self.registry, emit=self.emit, baseline=baseline
+        )
+        return self.profiler
+
+    def arm_flight(
+        self, path: str | None, capacity: int = 256, autodump_s: float = 2.0
+    ):
+        """Attach a :class:`~transformer_tpu.obs.flight.FlightRecorder`
+        tapped off :meth:`emit`; ``maybe_flush`` drives its autodumps and
+        ``close`` writes the final record."""
+        from transformer_tpu.obs.flight import FlightRecorder
+
+        self.flight = FlightRecorder(
+            path, capacity=capacity, autodump_s=autodump_s,
+            registry=self.registry, emit=self.emit,
+        )
+        return self.flight
 
     # ---- events -----------------------------------------------------------
 
     def emit(self, kind: str, **fields) -> None:
         if self.events is not None:
             self.events.emit(kind, **fields)
+        if self.flight is not None:
+            self.flight.record(kind, fields)
 
     # ---- periodic sinks ---------------------------------------------------
 
@@ -102,6 +137,12 @@ class Telemetry:
         Cheap to call every scheduler step / train dispatch: the common case
         is one ``perf_counter`` read and a compare."""
         now = time.perf_counter()
+        # The flight recorder's autodump runs at ITS cadence (autodump_s),
+        # not the sink interval — a SIGKILL can't trigger a dump, so the
+        # on-disk record's staleness bound must not inherit the (much
+        # longer) snapshot interval.
+        if self.flight is not None:
+            self.flight.maybe_dump()
         if not force and now - self._last_flush < self.interval:
             return False
         self._last_flush = now
@@ -135,24 +176,71 @@ class Telemetry:
 
     def close(self) -> None:
         self.maybe_flush(force=True)
+        if self.flight is not None:
+            self.flight.dump("close")
         if self._server is not None:
             self._server.shutdown()
             self._server = None
         if self.events is not None:
             self.events.close()
 
+    # ---- health -----------------------------------------------------------
+
+    def health(self) -> dict:
+        """Liveness + sink states, the ``/healthz`` document. ``ok`` is
+        False only when a sink has hard-downgraded (broken event log) —
+        breaker-open is a transient, reported but not fatal."""
+        doc: dict = {
+            "ok": True,
+            "pid": os.getpid(),
+            "ts": round(time.time(), 6),
+            "uptime_s": round(time.time() - self._t0, 3),
+            "sinks": {
+                "prom_file": {"enabled": bool(self.prom_path)},
+            },
+        }
+        if self.events is not None:
+            ev = {"broken": bool(getattr(self.events, "_broken", False))}
+            breaker = getattr(self.events, "_breaker", None)
+            if breaker is not None:
+                ev["breaker"] = getattr(breaker, "state", "unknown")
+            doc["sinks"]["event_log"] = ev
+            if ev["broken"]:
+                doc["ok"] = False
+        if self.flight is not None:
+            doc["flight"] = {
+                "depth": self.flight.depth(),
+                "dumps": self.flight.dumps,
+                "broken": self.flight._broken,
+            }
+        if self.profiler is not None:
+            doc["profiler"] = dict(self.profiler.stats)
+        return doc
+
     # ---- scrape endpoint --------------------------------------------------
 
     def start_prometheus_server(self, port: int) -> int:
-        """Serve ``GET /metrics`` (text exposition) on ``port`` from a daemon
-        thread; returns the bound port (pass 0 to let the OS pick — tests).
-        stdlib ``http.server`` only: the obs package takes no dependencies."""
+        """Serve ``GET /metrics`` (text exposition) and ``GET /healthz``
+        (liveness JSON) on ``port`` from a daemon thread; returns the bound
+        port (pass 0 to let the OS pick — tests). stdlib ``http.server``
+        only: the obs package takes no dependencies."""
         import http.server
+        import json as _json
 
         registry = self.registry
+        telemetry = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if self.path == "/healthz":
+                    doc = telemetry.health()
+                    body = _json.dumps(doc).encode()
+                    self.send_response(200 if doc["ok"] else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path not in ("/metrics", "/"):
                     self.send_error(404)
                     return

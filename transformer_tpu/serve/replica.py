@@ -37,6 +37,9 @@ router -> replica:
                                                           step boundary)
     {"type": "rollback"}                                  re-stage the resident
                                                           previous weights
+    {"type": "dump"}                                      flight-recorder dump:
+                                                          persist the ring and
+                                                          reply "flight"
     {"type": "shutdown"}                                  drain + exit
 
 replica -> router:
@@ -61,7 +64,14 @@ replica -> router:
     {"type": "upgraded", "ok": B, "version": V}           the step-boundary
                                                           flip landed (or its
                                                           ckpt.swap abort)
-    {"type": "stats", "stats": {...}}                     final, at shutdown
+    {"type": "flight", "record": {...}|null}              dump reply: the
+                                                          flight-recorder ring
+                                                          (obs/flight.py)
+    {"type": "stats", "stats": {...}
+     [, "perf": {...}]}                                   final, at shutdown;
+                                                          "perf" = per-program
+                                                          measured rows when
+                                                          the profiler is armed
 
 **Router HA** (``--ha``): the worker additionally listens on a localhost
 TCP control socket (ephemeral port, announced in ``ready``). A warm-standby
@@ -369,10 +379,20 @@ def main(argv=None) -> None:
     telemetry = None
     if args.metrics_jsonl:
         from transformer_tpu.obs import EventLog, Telemetry
+        from transformer_tpu.obs.flight import flight_path_for
 
         telemetry = Telemetry(
             events=EventLog(args.metrics_jsonl), trace=args.trace
         )
+        telemetry.arm_profiler()
+        # Tight autodump: the on-disk flight record is all a SIGKILL
+        # leaves behind, and the Supervisor's postmortem capture reads it
+        # — half a second bounds how much of the victim's last telemetry
+        # the fleet can lose (docs/OBSERVABILITY.md).
+        flight = telemetry.arm_flight(
+            flight_path_for(args.metrics_jsonl), autodump_s=0.5
+        )
+        flight.install_signal_handlers()
 
     if args.model_spec:
         with open(args.model_spec) as f:
@@ -569,6 +589,15 @@ def main(argv=None) -> None:
         if kind == "shutdown":
             sched.shutdown()
             return False
+        if kind == "dump":
+            # Explicit flight-recorder dump: persist the ring AND ship the
+            # record back over the wire — the Supervisor prefers the wire
+            # copy (fresher than the last autodump) when both exist.
+            record = None
+            if telemetry is not None and telemetry.flight is not None:
+                record = telemetry.flight.dump("request")
+            out.send({"type": "flight", "record": record})
+            return True
         if kind == "export_state":
             entries = []
             if prefix_cache is not None:
@@ -792,7 +821,12 @@ def main(argv=None) -> None:
                 hb["wv"] = sched.weight_version
             out.send(hb)
     flush_answers()
-    out.send({"type": "stats", "stats": {**dict(sched.stats), **stats_extra}})
+    final = {"type": "stats", "stats": {**dict(sched.stats), **stats_extra}}
+    if telemetry is not None and telemetry.profiler is not None:
+        # Measured per-program rows ride the clean-shutdown stats so the
+        # router benchmarks read p50s without re-parsing replica JSONLs.
+        final["perf"] = telemetry.profiler.summary()
+    out.send(final)
     if telemetry is not None:
         telemetry.close()
 

@@ -222,6 +222,13 @@ class ReplicaLink:
         self.wv: str | None = None
         self.control_port: int | None = None  # --ha takeover socket
         self.final_stats: dict | None = None  # replica's shutdown report
+        self.final_perf: dict | None = None   # profiler rows in that report
+        # Flight-recorder hooks (obs/flight.py): where this worker's
+        # on-disk dumps land (parsed from --metrics_jsonl at spawn), and
+        # the last record it shipped over the wire (a `dump` reply) — the
+        # Supervisor's postmortem capture reads these.
+        self.metrics_jsonl: str | None = None
+        self.flight_record: dict | None = None
 
     # -- transport surface (overridden by real links) -----------------------
 
@@ -271,7 +278,16 @@ class ReplicaProcess(ReplicaLink):
             sys.executable, "-m", "transformer_tpu.serve.replica",
             "--replica_name", name, "--role", role, *worker_args,
         ]
-        return cls(index, name, argv, role=role)
+        link = cls(index, name, argv, role=role)
+        # Remember where the worker's flight dumps will land (both
+        # `--metrics_jsonl PATH` and `--metrics_jsonl=PATH` spellings):
+        # the Supervisor salvages <path>.flight.json after a hard kill.
+        for i, arg in enumerate(worker_args):
+            if arg == "--metrics_jsonl" and i + 1 < len(worker_args):
+                link.metrics_jsonl = worker_args[i + 1] or None
+            elif arg.startswith("--metrics_jsonl="):
+                link.metrics_jsonl = arg.split("=", 1)[1] or None
+        return link
 
     def start_reader(self, inbox: "queue.Queue") -> None:
         threading.Thread(
@@ -881,6 +897,11 @@ class Router:
                 self._sup.on_state_injected(link, msg)
         elif kind == "stats":
             link.final_stats = msg.get("stats")  # bench introspection
+            link.final_perf = msg.get("perf")    # profiler rows (ditto)
+        elif kind == "flight":
+            # A `dump` reply: hold the freshest wire-shipped flight record
+            # for the Supervisor's postmortem capture.
+            link.flight_record = msg.get("record")
 
     def _on_answer(self, link: ReplicaLink, msg: dict) -> None:
         order = msg.get("rid")

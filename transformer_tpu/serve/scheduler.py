@@ -995,6 +995,22 @@ class ContinuousScheduler:
         # Tracing rides the telemetry bundle (Telemetry(trace=True) /
         # --trace); None disables every span site at one attribute check.
         self._tracer = getattr(telemetry, "tracer", None)
+        # Per-program dispatch profiler (obs/profile.py, armed via
+        # Telemetry.arm_profiler): clocks each canned program under the
+        # SAME base names the cost model prices, so the roofline report
+        # can join measured against predicted. The program this scheduler
+        # dispatches is fixed at construction by layout + kernel choice.
+        self._profiler = getattr(telemetry, "profiler", None)
+        _kind = (
+            "_paged_flash"
+            if self.paged and self.decode_kernel == "paged_flash"
+            else "_paged" if self.paged else ""
+        )
+        self._prog_step = "serve.pool_step" + _kind
+        self._prog_verify = "serve.pool_verify" + _kind
+        self._prog_prefill = "serve.slot_prefill" + (
+            "_paged" if self.paged else ""
+        )
         # Victim attribution for breaker transitions: the trace id of the
         # request whose fault is being recorded, set around the fallible
         # regions (admission, retirement feed, drafting) on the scheduler
@@ -1977,6 +1993,7 @@ class ContinuousScheduler:
                         self.pool.alloc.free_slot(slot)
                     n_suffix = prefill_len_for(L, self.prefill_chunk)
                     n = n_suffix
+            t_pf = time.perf_counter()
             if self.paged:
                 from transformer_tpu.kernels.kv_pool import KVPoolExhausted
 
@@ -2006,6 +2023,14 @@ class ContinuousScheduler:
         finally:
             if hit is not None:
                 hit.release()
+        if self._profiler is not None:
+            # Dispatch window (async: the device may still be prefilling —
+            # timed_call's caveat applies); tokens = the suffix actually
+            # fed through the forward, restored prefix excluded.
+            self._profiler.record(
+                self._prog_prefill, time.perf_counter() - t_pf,
+                tokens=n_suffix,
+            )
         if use_prefix and prefix_ok:
             # The cache served this admission end-to-end (hit or clean
             # miss): a half-open probe closes the breaker here.
@@ -2198,8 +2223,15 @@ class ContinuousScheduler:
         if self._tel is not None:
             # The np.asarray(_pick_pool) above was a real device sync, so
             # this window is genuine step time, not dispatch time.
-            self._m_step_s.observe(time.perf_counter() - t_step)
+            dt_step = time.perf_counter() - t_step
+            self._m_step_s.observe(dt_step)
             self._m_steps.inc()
+            if self._profiler is not None:
+                # One token per slot that picked this step: the honest
+                # token credit for a pool-step dispatch.
+                self._profiler.record(
+                    self._prog_step, dt_step, tokens=len(picks)
+                )
             self._m_active.set(len(self._active))
             self._m_backlog.set(len(self._queue))
             self._m_ready.set(len(self._done))
@@ -2221,6 +2253,7 @@ class ContinuousScheduler:
         answers are byte-identical to non-speculative serving
         (tests/test_speculative.py pins this)."""
         t_step = time.perf_counter()
+        n_rows = len(self._active)  # rows fed at dispatch (pre-retirement)
         step_span = draft_span = None
         if self._tracer is not None:
             step_span = self._tracer.start_span(
@@ -2399,8 +2432,15 @@ class ContinuousScheduler:
         if step_span is not None:
             step_span.end(drafted=drafted, accepted=accepted)
         if self._tel is not None:
-            self._m_step_s.observe(time.perf_counter() - t_step)
+            dt_step = time.perf_counter() - t_step
+            self._m_step_s.observe(dt_step)
             self._m_steps.inc()
+            if self._profiler is not None:
+                # W positions scored per fed row — the verify forward's
+                # honest work unit (cost-model tokens_per_step agrees).
+                self._profiler.record(
+                    self._prog_verify, dt_step, tokens=n_rows * W
+                )
             if drafted:
                 self._m_spec_drafted.inc(drafted)
                 if accepted:

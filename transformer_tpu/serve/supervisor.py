@@ -62,6 +62,7 @@ class _SlotState:
     __slots__ = (
         "index", "name", "role", "phase", "next_try", "attempts",
         "restarts", "died_at", "warm_deadline", "warm_source",
+        "postmortem_ts",
     )
 
     def __init__(self, index: int, name: str, role: str):
@@ -75,6 +76,10 @@ class _SlotState:
         self.died_at: float | None = None
         self.warm_deadline = 0.0
         self.warm_source: int | None = None
+        # ts of the last flight record captured for this slot — the dedupe
+        # key: repeated on_death calls for one incident (failover + exit
+        # sentinel) must not emit the same route.postmortem twice.
+        self.postmortem_ts: float | None = None
 
 
 class Supervisor:
@@ -129,6 +134,7 @@ class Supervisor:
         self.stats = {
             "respawns": 0, "spawn_attempts": 0, "spawn_failures": 0,
             "gave_up": 0, "warmed_tokens": 0, "scale_ups": 0, "retired": 0,
+            "postmortems": 0,
         }
         self.heal_times: list[float] = []  # death -> admitted, seconds
 
@@ -159,6 +165,7 @@ class Supervisor:
         slot = self._slot(link.index)
         if slot.phase == "gave_up":
             return  # the budget is spent; only an explicit re-arm respawns
+        self._capture_postmortem(slot, link)
         now = self._clock()
         if slot.phase == "up":
             slot.died_at = now
@@ -176,6 +183,38 @@ class Supervisor:
                 delay, self._router.breakers[link.index].cooldown_s
             )
         slot.next_try = now + delay
+
+    def _capture_postmortem(self, slot: _SlotState, link) -> None:
+        """Salvage the victim's final flight record (obs/flight.py) into a
+        ``route.postmortem`` event before the slot is recycled. Two
+        origins, freshest first: a record the worker shipped over the wire
+        (a ``dump`` reply), else the on-disk autodump next to its
+        ``--metrics_jsonl`` — the only trace a SIGKILL leaves. Best-effort
+        by contract: no recorder, no file, or a torn dump capture nothing
+        and never delay the respawn."""
+        record = getattr(link, "flight_record", None)
+        origin = "wire"
+        if record is None:
+            jsonl = getattr(link, "metrics_jsonl", None)
+            if jsonl:
+                from transformer_tpu.obs.flight import (
+                    flight_path_for,
+                    load_flight_record,
+                )
+
+                record = load_flight_record(flight_path_for(jsonl))
+                origin = "file"
+        if record is None:
+            return
+        ts = record.get("ts")
+        if ts is not None and ts == slot.postmortem_ts:
+            return  # same record already captured for this incident
+        slot.postmortem_ts = ts
+        self.stats["postmortems"] += 1
+        self._router.emit_event(
+            "route.postmortem", replica=slot.name, origin=origin,
+            reason=record.get("reason"), record=record,
+        )
 
     def _bootstrap(self, index: int, name: str, role: str):
         """One (re)spawn through the deterministic recipe — at the

@@ -643,6 +643,19 @@ class Trainer:
         self.train_step = timed_call(self.train_step, self._m_dispatch)
         if self.multi_step is not None:
             self.multi_step = timed_call(self.multi_step, self._m_dispatch)
+        profiler = getattr(self.telemetry, "profiler", None)
+        if profiler is not None:
+            # Third sibling in the chain (same jaxpr-inertness contract):
+            # the roofline sentinel's train.step stream.
+            from transformer_tpu.obs.profile import profile_call
+
+            self.train_step = profile_call(
+                self.train_step, profiler, "train.step"
+            )
+            if self.multi_step is not None:
+                self.multi_step = profile_call(
+                    self.multi_step, profiler, "train.step"
+                )
         if self._tracer is not None:
             from transformer_tpu.obs.trace import traced_call
 
