@@ -129,6 +129,15 @@ def main() -> None:
                         "transformer_tpu.obs roofline` replays ('' = no "
                         "event log; the profiler still runs and the "
                         "measured_* columns still populate)")
+    p.add_argument("--mesh", type=str, default="",
+                   help="comma-separated serving mesh sizes (e.g. '1,2,4'): "
+                        "run the repeated-system-prompt workload through a "
+                        "--mesh N ContinuousScheduler per size, dense AND "
+                        "paged, reporting per-mesh tokens/s + the predicted "
+                        "cross-shard collective bytes per decode step "
+                        "(answers asserted byte-identical to the unsharded "
+                        "scheduler); grows a virtual CPU device platform "
+                        "when the host has too few devices")
     p.add_argument("--reps", type=int, default=5,
                    help="timed repetitions (best-of is reported)")
     p.add_argument("--layers", type=int, default=2)
@@ -137,6 +146,20 @@ def main() -> None:
     p.add_argument("--dff", type=int, default=512)
     p.add_argument("--vocab", type=int, default=8192)
     args = p.parse_args()
+
+    # The --mesh sweep needs >= max(mesh) devices, and XLA only honours the
+    # virtual-device flag if it is in the environment BEFORE jax is imported
+    # — so grow XLA_FLAGS here, between argparse and the import below.
+    mesh_sizes = [int(x) for x in args.mesh.split(",") if x.strip()]
+    if any(m < 1 for m in mesh_sizes):
+        p.error("--mesh sizes must be >= 1")
+    if mesh_sizes:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={max(mesh_sizes)}"
+            ).strip()
 
     import jax
     import jax.numpy as jnp
@@ -682,6 +705,79 @@ def main() -> None:
                     f"({vname})"
                 )
 
+    # ---- sharded replica sweep (--mesh) -----------------------------------
+    # One replica = one multi-device pjit program (serve/sharded.py): params
+    # replicated over a 1-D "data" mesh, pool KV sharded on its leading
+    # storage axis (dense: slot rows, paged: block rows).  Per mesh size the
+    # row pairs measured tokens/s with the layout's PREDICTED cross-shard
+    # collective bytes per decode step: dense is collective-free by
+    # construction (the compiled-HLO gate in analysis/sharding.py enforces
+    # it), and paged pays for the gathered-view rows that live on other
+    # shards — view_bytes * (m - 1) / m.  Answers are asserted byte-identical
+    # to the unsharded scheduler per layout, greedy AND seeded-sampled.
+    mesh_rows = []
+    if mesh_sizes:
+        from transformer_tpu.analysis.costs import kv_cache_bytes
+        from transformer_tpu.serve import ContinuousScheduler
+
+        assert jax.device_count() >= max(mesh_sizes), (
+            f"--mesh {max(mesh_sizes)} needs >= that many devices, got "
+            f"{jax.device_count()} — the XLA_FLAGS bootstrap above only "
+            "works if no conflicting xla_force_host_platform_device_count "
+            "was already set"
+        )
+        mtok = _IdTok()
+        mreqs = _system_prompt_requests(
+            np.random.default_rng(2), args.vocab, args.prompt_len, 8
+        )
+        msampled = 0
+        for i, r in enumerate(mreqs):
+            r["max_new"] = args.decode_steps
+            if i % 3 == 2:
+                r.update(temperature=0.8, top_k=8, seed=1000 + i)
+                msampled += 1
+        mslots = 4  # divisible by every mesh size the sweep targets (1/2/4)
+        m_total = args.prompt_len + 4 + 1 + args.decode_steps
+        view_bytes = mslots * kv_cache_bytes(cfg, m_total)["bytes_per_slot"]
+        for layout in ("dense", "paged"):
+            want = None
+            for m in [None, *mesh_sizes]:
+                sched = ContinuousScheduler(
+                    params, cfg, mtok, num_slots=mslots,
+                    prefill_chunk=args.chunk, kv_layout=layout,
+                    kv_block=args.prefix_block, max_total=m_total,
+                    mesh=m,
+                )
+                t0 = time.perf_counter()
+                out = sched.run([dict(r) for r in mreqs])
+                wall = time.perf_counter() - t0
+                assert all("continuation" in r for r in out), out
+                got = [r["continuation"] for r in out]
+                if m is None:
+                    want = got
+                    continue
+                assert got == want, (
+                    f"mesh={m} ({layout}) changed answers vs the unsharded "
+                    "scheduler"
+                )
+                new_tokens = sum(len(mtok.encode(c)) for c in got)
+                mesh_rows.append({
+                    "mesh": f"data={m}",
+                    "kv_layout": layout,
+                    "tokens_per_sec": (
+                        round(new_tokens / wall, 1) if wall else None
+                    ),
+                    "wall_s": round(wall, 3),
+                    "predicted_collective_bytes_per_step": (
+                        0 if layout == "dense"
+                        else int(view_bytes * (m - 1) / m)
+                    ),
+                    "byte_parity": True,
+                    "slots": mslots,
+                    "requests": len(mreqs),
+                    "sampled_requests": msampled,
+                })
+
     print(json.dumps({
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "decode_tokens_per_sec": round(decode_tok_s, 1),
@@ -698,6 +794,7 @@ def main() -> None:
         **({"prefix_reuse": prefix} if prefix else {}),
         **({"kv_layouts": layout_rows} if layout_rows else {}),
         **({"decode_kernels": kernel_rows} if kernel_rows else {}),
+        **({"mesh_sweep": mesh_rows} if mesh_rows else {}),
     }))
 
     if kernel_rows or relay_row:
@@ -814,6 +911,40 @@ def main() -> None:
                 "vs_baseline": None,
             })
             for s in speculative
+        ]
+        if args.rows_out:
+            with open(args.rows_out, "a", encoding="utf-8") as f:
+                f.write("\n".join(rows) + "\n")
+        else:
+            for row in rows:
+                print(row, file=sys.stderr)
+
+    if mesh_rows:
+        rows = [
+            json.dumps({
+                "metric": "sharded decode tokens/s",
+                "value": r["tokens_per_sec"],
+                "unit": "tokens/sec",
+                "config": {
+                    "layers": args.layers, "d_model": args.d_model,
+                    "heads": args.heads, "dff": args.dff,
+                    "prompt_len": args.prompt_len,
+                    "decode_steps": args.decode_steps,
+                    "mesh": r["mesh"],
+                    "kv_layout": r["kv_layout"],
+                    "slots": r["slots"],
+                    "requests": r["requests"],
+                    "sampled_requests": r["sampled_requests"],
+                },
+                "predicted_collective_bytes_per_step": (
+                    r["predicted_collective_bytes_per_step"]
+                ),
+                "byte_parity": r["byte_parity"],
+                "wall_s": r["wall_s"],
+                "device": f"{dev.platform}:{dev.device_kind}",
+                "vs_baseline": None,
+            })
+            for r in mesh_rows
         ]
         if args.rows_out:
             with open(args.rows_out, "a", encoding="utf-8") as f:

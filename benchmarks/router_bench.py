@@ -258,6 +258,68 @@ def run_sweep(n_replicas: int, args, spec_path: str) -> dict:
     }
 
 
+def run_mesh_parity(args, spec_path: str) -> dict:
+    """Sharded-replica byte-parity soak (serve/sharded.py, ``--mesh``):
+    the SAME workload — greedy AND seeded-sampled requests — through
+    single-replica fleets at mesh 1/2/4 must answer byte-identically to
+    an UNSHARDED replica. Each worker grows its own virtual CPU platform
+    from ``--mesh`` (replica.py appends xla_force_host_platform_device_count
+    before importing jax), so the sweep runs on any host."""
+    from transformer_tpu.serve.replica import build_model_from_spec
+    from transformer_tpu.serve.router import ReplicaProcess, Router
+
+    _, _, tok = build_model_from_spec(SPEC)
+    reqs = _workload(16, 2, args.system_words)
+    for i, r in enumerate(reqs):
+        if i % 3 == 0:  # every third request is seeded-sampled
+            r.update(temperature=0.8, top_k=8, seed=i)
+    slots = 4  # divides every mesh in the sweep
+
+    def serve(mesh):
+        worker = [
+            "--model_spec", spec_path,
+            "--serve_slots", str(slots),
+            "--heartbeat_ms", "100",
+        ]
+        if mesh:
+            worker += ["--mesh", str(mesh)]
+        link = ReplicaProcess.spawn(0, worker)
+        router = Router(
+            [link], encode=tok.encode, bos_id=tok.bos_id,
+            heartbeat_timeout_s=30.0,
+        )
+        link.start_reader(router.inbox)
+        t0 = time.perf_counter()
+        out = router.run([dict(r) for r in reqs])
+        wall = time.perf_counter() - t0
+        reported = link.mesh
+        router.shutdown()
+        return [o.get("continuation") for o in out], wall, reported
+
+    want, _, base_mesh = serve(None)
+    assert base_mesh is None and all(c is not None for c in want), want
+    meshes = {}
+    for mesh in (1, 2, 4):
+        got, wall, reported = serve(mesh)
+        assert got == want, (
+            f"mesh={mesh} answers diverged from the unsharded replica"
+        )
+        assert reported == f"data={mesh}", (
+            f"replica announced mesh {reported!r}, expected data={mesh}"
+        )
+        meshes[str(mesh)] = {
+            "mesh": f"data={mesh}",
+            "wall_s": round(wall, 3),
+            "requests_per_sec": round(len(reqs) / wall, 2),
+            "byte_parity": True,
+        }
+    return {
+        "requests": len(reqs),
+        "sampled_requests": sum(1 for r in reqs if "temperature" in r),
+        "meshes": meshes,
+    }
+
+
 def run_heal(args, spec_path: str) -> dict:
     """The self-healing soak: 2 supervised replicas, SIGKILL one mid-run,
     measure death -> readmission and what the gap cost."""
@@ -494,6 +556,12 @@ def main() -> None:
                         "checkpoint swap across 2 replicas mid-run and "
                         "row time-to-upgrade, requests served during the "
                         "rollout, and the canary share")
+    p.add_argument("--mesh_parity", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="run the sharded-replica soak: the same greedy + "
+                        "seeded-sampled workload through --mesh 1/2/4 "
+                        "single-replica fleets, byte-parity asserted "
+                        "against an unsharded replica, one row per mesh")
     p.add_argument("--rows_out", type=str, default="",
                    help="append bench_rows.jsonl-compatible rows here "
                         "('' = print them to stderr)")
@@ -555,6 +623,27 @@ def main() -> None:
                 "device": device,
                 "vs_baseline": None,
             }))
+        if args.mesh_parity:
+            result = run_mesh_parity(args, spec_path)
+            print(json.dumps(result))
+            for r in result["meshes"].values():
+                assert r["byte_parity"], f"mesh parity broken: {result}"
+                rows.append(json.dumps({
+                    "metric": "router mesh requests/s",
+                    "value": r["requests_per_sec"],
+                    "unit": "req/s",
+                    "config": {
+                        "replicas": 1, "slots": 4, "mesh": r["mesh"],
+                        "requests": result["requests"],
+                        "sampled_requests": result["sampled_requests"],
+                    },
+                    # Asserted, not aspirational: the run aborts above if a
+                    # sharded fleet's bytes diverge from the unsharded one.
+                    "byte_parity": r["byte_parity"],
+                    "wall_s": r["wall_s"],
+                    "device": device,
+                    "vs_baseline": None,
+                }))
         if args.heal:
             result = run_heal(args, spec_path)
             print(json.dumps(result))

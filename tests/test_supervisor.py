@@ -381,6 +381,54 @@ def test_respawn_storm_via_fault_plane():
         install(None)
 
 
+def test_respawn_refuses_wrong_mesh_shape():
+    """A respawned replica that bootstraps at the WRONG mesh shape (stale
+    binary, hand-edited argv) is refused loudly — route.mesh_mismatch
+    event, killed before warm-up or traffic, one budgeted failure — and
+    the next (correct-shape) respawn is admitted. Rides the SIGKILL-heal
+    machinery with fake links so the drill is deterministic."""
+    clk = [0.0]
+    spawned = []
+
+    def spawn(index, name, role):
+        link = _FakeLink(index, name)
+        link.router = router
+        spawned.append(link)
+        # First replacement announces data=4 (wrong), the second data=2.
+        mesh = "data=4" if len(spawned) == 1 else "data=2"
+        router.inbox.put(
+            (index, {"type": "ready", "replica": name, "mesh": mesh})
+        )
+        return link
+
+    sup = Supervisor(
+        spawn, max_restarts=5, backoff_ms=0.0, clock=lambda: clk[0],
+        expected_mesh="data=2",
+    )
+    buf = io.StringIO()
+    telemetry = Telemetry(events=EventLog(buf))
+    router, links = _fake_fleet(2, supervisor=sup, telemetry=telemetry)
+    links[0].ok = False
+    router.inbox.put((0, {"type": "exit"}))
+    router.pump(timeout=0)
+    for _ in range(10):
+        clk[0] += 1.0
+        router.pump(timeout=0)
+        if sup.stats["respawns"] == 1:
+            break
+    # The wrong-shape link was killed without admission; the failure was
+    # budgeted (not free) and the correct-shape retry healed the fleet.
+    assert not spawned[0].ok and spawned[0].sent == []
+    assert spawned[1].ok and router.links[0] is spawned[1]
+    assert router.links[0].mesh == "data=2"
+    assert sup.stats["spawn_failures"] == 1
+    assert sup.stats["respawns"] == 1
+    assert sup._slots[0].phase == "up"
+    mm = [e for e in _events(buf) if e.get("kind") == "route.mesh_mismatch"]
+    assert len(mm) == 1
+    assert mm[0]["expected"] == "data=2" and mm[0]["got"] == "data=4"
+
+
 def test_route_hb_fault_swallows_heartbeats():
     """The route.hb fault point drops replica heartbeats at the router —
     heartbeat-loss storms without real stalls."""

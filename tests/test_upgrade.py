@@ -420,6 +420,78 @@ def test_corrupt_checkpoint_rejected_before_any_replica_swaps(tmp_path):
 
 
 @pytest.mark.chaos
+def test_mesh_mismatch_staging_answers_structured_refusal(lm):
+    """Sharded-replica twin check on the upgrade wire (serve/sharded.py):
+    a replica serving on a 2-device mesh refuses staged weights COMMITTED
+    to a different mesh — the real stage_params sharding check raises, the
+    worker answers a structured ``upgrade_staged`` refusal (exactly what
+    replica.py's _reap_upgrade_load sends), the coordinator aborts fleet-
+    wide, and serving is untouched on both the wire and the scheduler."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from transformer_tpu.serve import ContinuousScheduler
+    from transformer_tpu.serve.sharded import serving_mesh
+
+    params, cfg, tok = lm
+    sched = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, mesh=2, weight_version="vOLD"
+    )
+    req = {"prompt": "ab cd ef", "max_new": 4}
+    want = [r.get("continuation") for r in sched.run([dict(req)])]
+    # Structural twin of the serving params, but committed to a 4-device
+    # mesh: shapes/dtypes pass, the sharding check must not.
+    wrong = jax.device_put(
+        jax.tree.map(np.asarray, params),
+        NamedSharding(serving_mesh(4), PartitionSpec()),
+    )
+
+    class _MeshedReplica(_FakeReplica):
+        def send(self, msg):
+            if msg.get("type") == "upgrade":
+                self.upgrades_seen.append(dict(msg))
+                try:
+                    sched.stage_params(wrong, msg["version"])
+                except ValueError as e:
+                    self.router.inbox.put((self.index, {
+                        "type": "upgrade_staged", "ok": False,
+                        "version": msg["version"],
+                        "error": f"{type(e).__name__}: {e}",
+                    }))
+                    return
+                raise AssertionError("mismatched-mesh staging was accepted")
+            super().send(msg)
+
+    buf = io.StringIO()
+    telemetry = Telemetry(events=EventLog(buf))
+    up = UpgradeCoordinator(verify=lambda p: (p, "vNEW"))
+    links = [_MeshedReplica(0, "f0"), _FakeReplica(1, "f1")]
+    router = Router(links, encode=None, upgrader=up, telemetry=telemetry)
+    for link in links:
+        link.router = router
+    assert router.start_upgrade("/ckpt")["ok"]
+    _drive(router, up, lambda: up.state in ("failed", "rolled_back"))
+    assert up.state == "failed", up.state
+    assert up.stats["aborted"] == 1
+    assert all(l.cur == "vOLD" for l in links)
+    assert router.weight_target is None
+    # Zero serving impact: no pending swap, identical answers, and the
+    # fleet still serves on the old version.
+    assert not sched.swap_pending
+    assert [
+        r.get("continuation") for r in sched.run([dict(req)])
+    ] == want
+    out = router.run([{"prompt": "p"}] * 3)
+    assert all(o["weight_version"] == "vOLD" for o in out)
+    telemetry.maybe_flush(force=True)
+    failed = [
+        e for e in _events(buf)
+        if e.get("kind") == "route.upgrade" and e.get("phase") == "failed"
+    ]
+    assert len(failed) == 1 and "sharding" in failed[0]["error"]
+
+
+@pytest.mark.chaos
 def test_canary_rollback_on_injected_burn():
     """The auto-rollback ladder: route.canary marks every canary answer
     bad in the per-version SLO split, burn > 1 sustains across the

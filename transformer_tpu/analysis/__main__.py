@@ -296,11 +296,13 @@ def _cmd_contracts(args: argparse.Namespace) -> int:
 
 
 def _cmd_retrace(args: argparse.Namespace) -> int:
+    _ensure_cpu_devices()  # the sharded scenario needs a >= 2-device mesh
     from transformer_tpu.analysis.retrace import (
         decode_retrace_report,
         paged_retrace_report,
         prefix_cache_retrace_report,
         resilience_retrace_report,
+        sharded_retrace_report,
         speculative_retrace_report,
         train_retrace_report,
         upgrade_retrace_report,
@@ -314,6 +316,7 @@ def _cmd_retrace(args: argparse.Namespace) -> int:
         + resilience_retrace_report(steps=args.steps)
         + upgrade_retrace_report(steps=args.steps)
         + train_retrace_report(steps=args.steps)
+        + sharded_retrace_report(steps=args.steps)
     )
     ok = all(d.within_budget for d in deltas)
     text = "\n".join(

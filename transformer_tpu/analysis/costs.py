@@ -799,6 +799,24 @@ def compare_to_baseline(
                 f"twin's {twin.bytes_moved} ({twin.name}) — the fused kernel "
                 "no longer eliminates the gathered-view HBM pass"
             )
+    # Structural sharded-serving gate: the --mesh serving programs are
+    # collective-free BY CONSTRUCTION (params replicate, the pool shards a
+    # batch-like storage axis — serve/sharded.py) and their byte-parity
+    # guarantee depends on it. Like the fused-vs-gather ordering, this is a
+    # property of the program structure: even a baselined count would be
+    # wrong, so any explicit collective here fails regardless of what the
+    # baseline says. (GSPMD-inserted collectives are gated on the compiled
+    # HLO in run_costs — tracing cannot see them.)
+    for name in sorted(by_name):
+        if not (name.startswith("serve.") and "mesh=" in name):
+            continue
+        if by_name[name].collectives:
+            kinds = ", ".join(sorted(by_name[name].collectives))
+            regressions.append(
+                f"{name}: explicit collective(s) in the sharded serving hot "
+                f"loop ({kinds}) — the --mesh byte-parity layout forbids "
+                "them (serve/sharded.py)"
+            )
     skipped = set(skipped)
     for name in sorted(set(base_programs) - seen):
         if name in skipped:
@@ -864,6 +882,32 @@ def run_costs(
             notes.append(
                 f"no baseline at {baseline_path} — run --update-baseline "
                 "to pin budgets"
+            )
+        # Compiled-HLO collective gate (analysis/sharding.py): GSPMD
+        # partitions AFTER tracing, so a collective it inserts into the
+        # sharded decode step is invisible to every jaxpr-level number
+        # above. Compile the dense mesh-2 decode twins for real and fail
+        # hard on any collective op in the HLO text.
+        from transformer_tpu.analysis.sharding import serving_hlo_collectives
+
+        hlo_inventory, hlo_skipped = serving_hlo_collectives()
+        for name, found in sorted(hlo_inventory.items()):
+            if found:
+                regressions.append(
+                    f"{name}: GSPMD-inserted collective(s) in the COMPILED "
+                    "decode step: "
+                    + ", ".join(
+                        f"{k} x{v}" for k, v in sorted(found.items())
+                    )
+                    + " — the sharded serving hot loop must stay "
+                    "collective-free (serve/sharded.py)"
+                )
+            else:
+                notes.append(f"{name}: compiled HLO collective-free")
+        for name in hlo_skipped:
+            notes.append(
+                f"{name}: compiled-HLO collective gate skipped "
+                "(insufficient devices)"
             )
     return CostsResult(
         reports=reports, kv=kv, skipped=skipped,

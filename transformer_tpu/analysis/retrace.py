@@ -516,3 +516,55 @@ def train_retrace_report(steps: int = 3) -> list[WatchDelta]:
         src, tgt = batch()
         state, _ = step(state, src, tgt, jax.random.PRNGKey(i))
     return sentinel.deltas()
+
+
+def sharded_retrace_report(steps: int = 3) -> list[WatchDelta]:
+    """Steady-state SHARDED serving (``--mesh``, serve/sharded.py): one
+    LONG-LIVED scheduler whose canned programs are per-instance pjit twins
+    over a 2-device mesh — the twins live on the instance, so the watched
+    jit objects must be the scheduler's own, not the module-level ones.
+    Same bucketing contract as the unsharded scenarios: after warmup, the
+    sharded decode step, verify, prefill, and the shared pick programs
+    must compile ZERO new programs. A resharding leak — an operand whose
+    committed sharding drifts between calls, re-keying the pjit cache —
+    shows up here as a steady-state retrace."""
+    from transformer_tpu.serve import scheduler as sched
+    from transformer_tpu.serve.scheduler import ContinuousScheduler
+
+    if len(jax.devices()) < 2:
+        # The CLI forces 8 virtual CPU devices before importing jax
+        # (_ensure_cpu_devices); a bare interpreter without them cannot
+        # build the mesh, so the scenario reports nothing rather than
+        # failing for a reason that is not a retrace.
+        return []
+    cfg, params, tok = _tiny_lm_setup()
+    s = ContinuousScheduler(
+        params, cfg, tok, num_slots=2, max_total=32, default_max_new=4,
+        mesh=2, speculate_k=2,
+    )
+    # Greedy only: the tiny bf16 analysis model NaNs under sampled
+    # residual draws regardless of mesh (a numeric quirk of the canned
+    # config, not a serving property); sampled-request parity is
+    # tests/test_sharded.py's statement, over float32 models.
+    waves = [
+        [{"prompt": "the quick brown fox"}, {"prompt": "dog dog dog dog"}],
+        [{"prompt": "the the the the the"}, {"prompt": "the lazy dog"}],
+    ]
+    for wave in waves:  # warmup covers every prefill bucket the waves touch
+        out = s.run([dict(r) for r in wave])
+        assert all("continuation" in r for r in out), out
+    sentinel = RetraceSentinel()
+    sentinel.watch("sharded decode(pool_step)", s._sharded.pool_step, budget=0)
+    sentinel.watch("sharded verify(pool_verify)", s._sharded.pool_verify,
+                   budget=0)
+    sentinel.watch("sharded rollback(pool_rollback)", s._sharded.pool_rollback,
+                   budget=0)
+    sentinel.watch("sharded prefill(slot_prefill)", s._sharded.slot_prefill,
+                   budget=0)
+    sentinel.watch("pick(_pick_pool_verify) on sharded logits",
+                   sched._pick_pool_verify, budget=0)
+    sentinel.snapshot()
+    for i in range(steps):
+        out = s.run([dict(r) for r in waves[i % len(waves)]])
+        assert all("continuation" in r for r in out), out
+    return sentinel.deltas()

@@ -324,8 +324,117 @@ def canned_sharded_programs() -> tuple[dict[str, tuple], list[str]]:
             ),
             {"fsdp": 2},
         )
+
+    # -- the SHARDED serving hot loop (serve/sharded.py, --mesh): the
+    # programs a --mesh 2 replica jits as pjit twins. At trace level they
+    # carry ZERO explicit collectives (params replicate; the pool shards on
+    # a batch-like storage axis; cross-shard traffic is GSPMD data
+    # movement) — banking them at mesh 2 makes ANY explicit collective that
+    # sneaks into the decode/verify/prefill path a hard "stray collective"
+    # failure against costs_baseline.json. GSPMD-INSERTED collectives are
+    # invisible to a trace; serving_hlo_collectives() below gates those on
+    # the compiled HLO.
+    mesh = _mesh_1d("data", 2)
+    _serve_names = [
+        "serve.pool_step[lm_bf16,mesh=2]",
+        "serve.pool_verify[lm_bf16,W=4,mesh=2]",
+        "serve.slot_prefill[lm_bf16,n=8,mesh=2]",
+    ]
+    if mesh is None:
+        skipped.extend(_serve_names)
+    else:
+        from transformer_tpu.analysis.configs import FAST_MATRIX
+        from transformer_tpu.models.transformer import transformer_init
+        from transformer_tpu.serve import scheduler as sched
+        from transformer_tpu.serve.scheduler import abstract_pool_caches
+
+        cfg = FAST_MATRIX["lm_bf16"]
+        key = jax.ShapeDtypeStruct((2,), np.uint32)
+        params = jax.eval_shape(lambda k: transformer_init(k, cfg), key)
+        pool = abstract_pool_caches(cfg, 2, 32)
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)  # noqa: E731
+        step_raw = sched._pool_step.__wrapped__
+        verify_raw = sched._pool_verify.__wrapped__
+        prefill_raw = sched._slot_prefill.__wrapped__
+        programs[_serve_names[0]] = (
+            lambda p, c, t: step_raw(p, c, t, cfg),
+            (params, pool, i32(2)),
+            {"data": 2},
+        )
+        programs[_serve_names[1]] = (
+            lambda p, c, t: verify_raw(p, c, t, cfg),
+            (params, pool, i32(2, 4)),
+            {"data": 2},
+        )
+        programs[_serve_names[2]] = (
+            lambda p, c, s, pr, st: prefill_raw(p, c, s, pr, st, cfg, 0),
+            (params, pool, i32(), i32(1, 8), i32()),
+            {"data": 2},
+        )
     del jnp
     return programs, skipped
+
+
+# ==========================================================================
+# compiled-HLO collective gate for the sharded serving decode step
+
+# HLO op spellings of the cross-device collectives (sync + async start
+# forms share these prefixes).
+_HLO_COLLECTIVE_RE = (
+    r"\b(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast)"
+)
+
+
+def serving_hlo_collectives() -> tuple[dict[str, dict[str, int]], list[str]]:
+    """Compile the DENSE sharded decode-step twins at mesh 2 and inventory
+    collectives in the compiled HLO — the layer a jaxpr trace cannot see
+    (GSPMD inserts collectives at partitioning time, after tracing).
+
+    The serving layout (serve/sharded.py) makes the dense decode step
+    embarrassingly parallel: params fully replicated, pool KV + step
+    tokens + logits all sharded on the slot axis — so its compiled HLO
+    must contain ZERO collectives, and ``analysis costs`` fails hard on
+    any. Prefill and the paged programs legitimately move data across
+    shards (replicated prompt rows into a sharded slot, block-row gathers
+    through the table) — that traffic is deterministic data movement, not
+    a reduction, so it is not gated here.
+
+    Returns ``(inventory, skipped)`` where inventory maps program name ->
+    {hlo_op: count} (empty dict = clean)."""
+    import re
+
+    import jax
+    import numpy as np
+
+    from transformer_tpu.analysis.configs import FAST_MATRIX
+    from transformer_tpu.models.transformer import transformer_init
+    from transformer_tpu.serve.scheduler import abstract_pool_caches
+    from transformer_tpu.serve.sharded import ShardedPrograms, serving_mesh
+
+    names = [
+        "serve.pool_step[lm_bf16,mesh=2]",
+        "serve.pool_verify[lm_bf16,W=4,mesh=2]",
+    ]
+    if len(jax.devices()) < 2:
+        return {}, names
+    cfg = FAST_MATRIX["lm_bf16"]
+    key = jax.ShapeDtypeStruct((2,), np.uint32)
+    params = jax.eval_shape(lambda k: transformer_init(k, cfg), key)
+    pool = abstract_pool_caches(cfg, 2, 32)
+    sp = ShardedPrograms(serving_mesh(2), params)
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)  # noqa: E731
+    out: dict[str, dict[str, int]] = {}
+    for name, fn, args in (
+        (names[0], sp.pool_step, (params, pool, i32(2), cfg)),
+        (names[1], sp.pool_verify, (params, pool, i32(2, 4), cfg)),
+    ):
+        text = fn.lower(*args).compile().as_text()
+        found: dict[str, int] = {}
+        for m in re.finditer(_HLO_COLLECTIVE_RE, text):
+            found[m.group(1)] = found.get(m.group(1), 0) + 1
+        out[name] = found
+    return out, []
 
 
 # ==========================================================================

@@ -83,6 +83,14 @@ def define_router_flags() -> None:
         "kv_pool_blocks", 0,
         "paged pool size per replica, in --prefix_block-token blocks "
         "(0 = full provisioning)")
+    flags.DEFINE_string(
+        "mesh", "",
+        "serving mesh per replica ('N' or 'data=N'): each worker becomes "
+        "one pjit program over N devices (docs/SERVING.md 'Sharded "
+        "replicas'). Rides the deterministic spawn argv, so supervised "
+        "respawns and scale-ups inherit the shape; heartbeats report it "
+        "and the supervisor refuses a wrong-shape replacement. '' = "
+        "single-device workers")
     flags.DEFINE_integer(
         "affinity_block", 0,
         "token-block granularity for prefix-affinity hashing "
@@ -208,6 +216,8 @@ def worker_args_from_flags(replica_jsonl: str = "") -> list[str]:
         out += ["--metrics_jsonl", replica_jsonl]
         if FLAGS.trace:
             out += ["--trace"]
+    if FLAGS.mesh:
+        out += ["--mesh", FLAGS.mesh]
     if FLAGS.fault_spec:
         out += ["--fault_spec", FLAGS.fault_spec]
     if FLAGS.ha or FLAGS.standby:
@@ -332,12 +342,17 @@ def _supervision_kwargs() -> dict:
         ),
     }
     if FLAGS.supervise:
+        from transformer_tpu.serve.sharded import normalize_mesh_spec
+
         out["supervisor"] = Supervisor(
             _spawn_recipe(),
             max_restarts=FLAGS.max_restarts,
             restart_window_s=FLAGS.restart_window,
             backoff_ms=FLAGS.spawn_backoff_ms,
             warm_prefixes=FLAGS.warm_prefixes,
+            # Canonicalized ('data=N') so the flag spelling can never
+            # alias into a false wrong-shape refusal.
+            expected_mesh=normalize_mesh_spec(FLAGS.mesh),
         )
     slo_spec = FLAGS.slo_spec
     autoscale = FLAGS.supervise and FLAGS.max_replicas > 0

@@ -43,6 +43,12 @@ The kinds this repo emits (schema in docs/OBSERVABILITY.md):
 - ``route.takeover`` — emitted once by an adopting standby: the new
   ``epoch``, adopted/failed replicas, and how every undelivered order
   was resolved (recovered / re-owned / re-dispatched).
+- ``route.mesh_mismatch`` — the Supervisor refused a spawned replica
+  whose ``ready`` line reported a mesh shape different from the fleet's
+  ``expected_mesh`` (``expected``, ``got``): the link is killed, the
+  attempt counts as a spawn failure, and respawn backoff applies — a
+  heal can never silently downgrade a sharded replica
+  (docs/SERVING.md "Sharded replicas").
 - ``route.upgrade`` / ``route.canary`` — the live-weights control plane
   (``serve/upgrade.py``): rollout lifecycle events tagged by ``phase``
   (``started``/``swapped``/``completed``/``rejected``/``failed``/
@@ -131,6 +137,7 @@ EVENT_CATALOGUE = {
     "route.failover": "replica failure with victim orders re-dispatched",
     "route.hb": "HA journal: periodic primary liveness beacon",
     "route.intake": "HA journal: one replayable accepted-order record",
+    "route.mesh_mismatch": "respawned replica reported the wrong mesh shape",
     "route.postmortem": "supervisor captured a dead replica's flight record",
     "route.retire": "supervised drain-and-retire completed",
     "route.revive": "half-open breaker revived a heartbeat-timeout victim",

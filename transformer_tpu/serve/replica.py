@@ -128,6 +128,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import os
 import queue
 import socket
 import sys
@@ -306,6 +307,14 @@ def _parse_args(argv=None) -> argparse.Namespace:
                         "the paged block pool (docs/SERVING.md)")
     p.add_argument("--kv_pool_blocks", type=int, default=0,
                    help="paged pool size in blocks (0 = full provisioning)")
+    p.add_argument("--mesh", default="",
+                   help="serving mesh size ('N' or 'data=N'): the replica "
+                        "becomes ONE pjit program over N devices — params "
+                        "replicated by the partition rules, KV pool sharded "
+                        "on its storage axis (docs/SERVING.md 'Sharded "
+                        "replicas'). On CPU the worker grows its own "
+                        "virtual platform before jax initializes. '' = "
+                        "single-device (historical)")
     p.add_argument("--max_backlog", type=int, default=0)
     p.add_argument("--heartbeat_ms", type=float, default=200.0)
     p.add_argument("--metrics_jsonl", default="")
@@ -371,6 +380,25 @@ def _control_server(listener: socket.socket, q: "queue.Queue") -> None:
 
 def main(argv=None) -> None:
     args = _parse_args(argv)
+    # --mesh bootstrap must precede the FIRST jax-importing line: on the
+    # CPU platform the worker grows its own virtual device count (the
+    # conftest/analysis trick), which only takes effect before jax
+    # initializes. The flag only affects CPU hosts — on TPU it is inert —
+    # and an operator-provided device-count flag always wins.
+    from transformer_tpu.serve.sharded import (
+        normalize_mesh_spec,
+        parse_mesh_spec,
+    )
+
+    mesh_n = parse_mesh_spec(args.mesh)
+    mesh_shape = normalize_mesh_spec(args.mesh)
+    if mesh_n is not None and mesh_n > 1:
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            os.environ["XLA_FLAGS"] = (
+                xla_flags
+                + f" --xla_force_host_platform_device_count={mesh_n}"
+            ).strip()
     if args.fault_spec:
         from transformer_tpu.serve import resilience
 
@@ -461,6 +489,7 @@ def main(argv=None) -> None:
         kv_layout=args.kv_layout,
         kv_block=args.prefix_block,
         kv_pool_blocks=args.kv_pool_blocks,
+        mesh=mesh_n,
         weight_version=weight_version,
         span_tap=lambda span: spans_by_order.__setitem__(
             span.get("order"), span
@@ -490,6 +519,11 @@ def main(argv=None) -> None:
         ready["control_port"] = control_port
     if weight_version is not None:
         ready["weight_version"] = weight_version
+    if mesh_shape is not None:
+        # Canonical mesh shape ('data=N'): the supervisor compares this
+        # against its expected_mesh and refuses a wrong-shape respawn
+        # BEFORE the replica takes traffic.
+        ready["mesh"] = mesh_shape
     out.send(ready)
 
     hb_s = max(args.heartbeat_ms, 1.0) / 1e3
@@ -819,6 +853,8 @@ def main(argv=None) -> None:
             }
             if sched.weight_version is not None:
                 hb["wv"] = sched.weight_version
+            if mesh_shape is not None:
+                hb["mesh"] = mesh_shape
             out.send(hb)
     flush_answers()
     final = {"type": "stats", "stats": {**dict(sched.stats), **stats_extra}}

@@ -220,6 +220,12 @@ class ReplicaLink:
         # upgraded messages), None until the fleet is version-tagged.
         self.upgrading = False
         self.wv: str | None = None
+        # Sharded-replica shape (serve/sharded.py): the canonical
+        # 'data=N' string the replica's ready/hb messages report, None
+        # for a single-device worker. The Supervisor's expected_mesh
+        # check reads this at admission — the router itself never sees
+        # device topology beyond the string.
+        self.mesh: str | None = None
         self.control_port: int | None = None  # --ha takeover socket
         self.final_stats: dict | None = None  # replica's shutdown report
         self.final_perf: dict | None = None   # profiler rows in that report
@@ -858,6 +864,8 @@ class Router:
             link.hb_active = int(msg.get("active", 0))
             if msg.get("wv") is not None:
                 link.wv = msg["wv"]
+            if msg.get("mesh") is not None:
+                link.mesh = msg["mesh"]
         elif kind == "prefilled":
             self._on_prefilled(link, msg)
         elif kind == "exit":
@@ -882,6 +890,10 @@ class Router:
                 # verified version it serves — a respawn mid-rollout comes
                 # up already converged to the fleet's target.
                 link.wv = msg["weight_version"]
+            if msg.get("mesh") is not None:
+                # Captured BEFORE on_ready: the supervisor's wrong-shape
+                # refusal judges the replica's announced mesh.
+                link.mesh = msg["mesh"]
             if self._sup is not None and link.warming:
                 self._sup.on_ready(link)
         elif kind in ("upgrade_staged", "upgraded"):

@@ -823,6 +823,7 @@ class ContinuousScheduler:
         kv_pool_blocks: int = 0,
         decode_kernel: str = "xla",
         weight_version: "str | None" = None,
+        mesh: "int | str | None" = None,
     ):
         if not cfg.decoder_only:
             raise ValueError(
@@ -883,6 +884,40 @@ class ContinuousScheduler:
             # a device-tier hit aliases trie-held pool blocks straight
             # into the slot's table.
             kv_block = prefix_cache.block_tokens
+        # ---- sharded replica (serve/sharded.py, --mesh) -------------------
+        # mesh = N makes this scheduler a pjit program over an N-device
+        # serving mesh: params replicated by the partition rules, pool KV
+        # sharded on its leading storage axis, every canned program
+        # re-jitted with explicit in/out shardings (the _fn_* dispatch
+        # below). mesh = None is the historical single-device path,
+        # byte-for-byte untouched.
+        from transformer_tpu.serve.sharded import parse_mesh_spec
+
+        self.mesh_size = parse_mesh_spec(mesh)
+        self._sharded = None
+        if self.mesh_size is not None:
+            if decode_kernel == "paged_flash":
+                raise ValueError(
+                    "decode_kernel='paged_flash' is a single-device fused-"
+                    "kernel program (models/paged_decode.py reads pool "
+                    "blocks in place); serve --mesh replicas with the "
+                    "gather-view programs (decode_kernel='xla')"
+                )
+            if num_slots % self.mesh_size:
+                raise ValueError(
+                    f"num_slots={num_slots} must divide the serving mesh "
+                    f"(data={self.mesh_size}): the pool shards on the slot "
+                    "axis, and a ragged shard would fail at the first "
+                    "dispatch instead of here"
+                )
+            if kv_layout == "paged":
+                # The paged pool shards on the block-row axis: round the
+                # pool up to a multiple of the mesh so every shard holds
+                # the same number of block rows. The extra rows just sit
+                # on the allocator's free list.
+                slot_blocks = -(-(self.max_total + speculate_k) // kv_block)
+                blocks = kv_pool_blocks or (1 + num_slots * slot_blocks)
+                kv_pool_blocks = blocks + (-blocks) % self.mesh_size
         self.pool = SlotPool(
             cfg, num_slots, self.max_total + speculate_k,
             kv_layout=kv_layout, kv_block=kv_block,
@@ -911,6 +946,48 @@ class ContinuousScheduler:
             check_paged_flash_config(cfg)
         self.decode_kernel = decode_kernel
         self._kernel_interpret = jax.default_backend() != "tpu"
+        # ---- program dispatch: module-level jits or sharded twins ---------
+        # Unsharded schedulers dispatch the module-level programs (shared
+        # compile caches across schedulers — the retrace budgets pin them);
+        # a sharded scheduler dispatches its own pjit twins with explicit
+        # in/out shardings over the serving mesh. Same signatures, same
+        # statics, same donation — call sites below never branch.
+        if self.mesh_size is not None:
+            from transformer_tpu.serve.sharded import (
+                ShardedPrograms,
+                serving_mesh,
+            )
+
+            self._mesh = serving_mesh(self.mesh_size)
+            sp = self._sharded = ShardedPrograms(self._mesh, self.params)
+            self.params = sp.place_params(self.params)
+            self.pool.caches = sp.place_pool(self.pool.caches)
+            self._fn_pool_step = sp.pool_step
+            self._fn_pool_verify = sp.pool_verify
+            self._fn_pool_rollback = sp.pool_rollback
+            self._fn_slot_prefill = sp.slot_prefill
+            self._fn_slot_restore = sp.slot_restore
+            self._fn_slot_read_blocks = sp.slot_read_blocks
+            self._fn_pool_step_paged = sp.pool_step_paged
+            self._fn_pool_verify_paged = sp.pool_verify_paged
+            self._fn_slot_prefill_paged = sp.slot_prefill_paged
+            self._fn_pool_write_blocks = sp.pool_write_blocks
+            self._fn_pool_read_block = sp.pool_read_block
+            self._fn_pool_copy_blocks = sp.pool_copy_blocks
+        else:
+            self._mesh = None
+            self._fn_pool_step = _pool_step
+            self._fn_pool_verify = _pool_verify
+            self._fn_pool_rollback = _pool_rollback
+            self._fn_slot_prefill = _slot_prefill
+            self._fn_slot_restore = _slot_restore
+            self._fn_slot_read_blocks = _slot_read_blocks
+            self._fn_pool_step_paged = _pool_step_paged
+            self._fn_pool_verify_paged = _pool_verify_paged
+            self._fn_slot_prefill_paged = _slot_prefill_paged
+            self._fn_pool_write_blocks = _pool_write_blocks
+            self._fn_pool_read_block = _pool_read_block
+            self._fn_pool_copy_blocks = _pool_copy_blocks
         if self.paged and prefix_cache is not None:
             # Device-resident prefix tier: retiring slots donate their
             # prompt blocks by aliasing (refcount, zero copies), hits
@@ -1199,7 +1276,7 @@ class ContinuousScheduler:
         supervisor cache warming). The device-resident HIT path never
         reaches here (pinned by test)."""
         return jax.device_get(
-            _pool_read_block(self.pool.caches, jnp.int32(bid))
+            self._fn_pool_read_block(self.pool.caches, jnp.int32(bid))
         )
 
     def _paged_alloc(self, fn):
@@ -1241,7 +1318,9 @@ class ContinuousScheduler:
         if pairs:
             src = jnp.asarray(_pow2_pad([s for s, _ in pairs]), jnp.int32)
             dst = jnp.asarray(_pow2_pad([d for _, d in pairs]), jnp.int32)
-            self.pool.caches = _pool_copy_blocks(self.pool.caches, src, dst)
+            self.pool.caches = self._fn_pool_copy_blocks(
+                self.pool.caches, src, dst
+            )
 
     def _paged_restore(self, slot: int, hit, m: int) -> int:
         """Paged restore of a matched ``m``-token prefix: device-tier
@@ -1279,7 +1358,7 @@ class ContinuousScheduler:
                 }
                 for li in range(len(host_payload[0]))
             ]
-            self.pool.caches = _pool_write_blocks(
+            self.pool.caches = self._fn_pool_write_blocks(
                 self.pool.caches, jnp.asarray(bids, jnp.int32), stacked
             )
             for node, bid in adopt:
@@ -1351,6 +1430,24 @@ class ContinuousScheduler:
                 f"({'; '.join(mismatched[:3])}) — refused before any swap "
                 "was scheduled"
             )
+        if self._sharded is not None:
+            # Sharded replica: the twin check grows SHARDING specs. Staged
+            # leaves already committed to a device layout must match the
+            # serving mesh's partition rules — a pytree living on a
+            # different mesh would reshard (or crash) at the flip, so it
+            # is refused here with serving untouched; host-loaded arrays
+            # (the checkpoint path) pass and are committed below, keeping
+            # the swap zero-recompile.
+            bad = self._sharded.check_staged_shardings(params)
+            if bad:
+                raise ValueError(
+                    f"staged weights for version {version!r} carry sharding "
+                    f"specs incompatible with the serving mesh "
+                    f"(data={self.mesh_size}) on {len(bad)} leaf/leaves "
+                    f"({'; '.join(bad[:3])}) — refused before any swap was "
+                    "scheduled"
+                )
+            params = self._sharded.place_params(params)
         self._staged = (params, str(version))
 
     def stage_rollback(self) -> str:
@@ -1968,7 +2065,7 @@ class ContinuousScheduler:
                         if self.paged:
                             aliased = self._paged_restore(slot, hit, m)
                         else:
-                            self.pool.caches = _slot_restore(
+                            self.pool.caches = self._fn_slot_restore(
                                 self.pool.caches, jnp.int32(slot),
                                 hit.stacked(self.max_total + self.speculate_k),
                             )
@@ -2002,7 +2099,7 @@ class ContinuousScheduler:
                     self._paged_cow(slot, m, n)
                 except KVPoolExhausted as e:
                     raise TransientError(str(e)) from e
-                logits, self.pool.caches = _slot_prefill_paged(
+                logits, self.pool.caches = self._fn_slot_prefill_paged(
                     self.params, self.pool.caches,
                     self.pool.alloc.table_device(), jnp.int32(slot),
                     jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m),
@@ -2010,7 +2107,7 @@ class ContinuousScheduler:
                     self.pool.block_tokens, self.pool.buf_len,
                 )
             else:
-                logits, self.pool.caches = _slot_prefill(
+                logits, self.pool.caches = self._fn_slot_prefill(
                     self.params, self.pool.caches, jnp.int32(slot),
                     jnp.asarray([ids[m:n]], jnp.int32), jnp.int32(m), self.cfg,
                     self.prefill_chunk,
@@ -2177,14 +2274,14 @@ class ContinuousScheduler:
                 self.pool.block_tokens, self._kernel_interpret,
             )
         elif self.paged:
-            logits, self.pool.caches = _pool_step_paged(
+            logits, self.pool.caches = self._fn_pool_step_paged(
                 self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/elif/else triplet: exactly one branch runs per step and all rebind self.pool.caches from their own result
                 self.pool.alloc.table_device(), jnp.asarray(positions),
                 jnp.asarray(toks), self.cfg,
                 self.pool.block_tokens, self.pool.buf_len,
             )
         else:
-            logits, self.pool.caches = _pool_step(
+            logits, self.pool.caches = self._fn_pool_step(
                 self.params, self.pool.caches, jnp.asarray(toks), self.cfg
             )
         groups: dict[tuple, list[int]] = {}
@@ -2320,14 +2417,14 @@ class ContinuousScheduler:
                 self.pool.block_tokens, self._kernel_interpret,
             )
         elif self.paged:
-            logits, self.pool.caches = _pool_verify_paged(
+            logits, self.pool.caches = self._fn_pool_verify_paged(
                 self.params, self.pool.caches,  # tpa: disable=TPA005 — exclusive if/elif/else triplet: exactly one branch runs per step and all rebind self.pool.caches from their own result
                 self.pool.alloc.table_device(), jnp.asarray(positions),
                 jnp.asarray(toks), self.cfg,
                 self.pool.block_tokens, self.pool.buf_len,
             )
         else:
-            logits, self.pool.caches = _pool_verify(
+            logits, self.pool.caches = self._fn_pool_verify(
                 self.params, self.pool.caches, jnp.asarray(toks), self.cfg
             )
         groups: dict[tuple, list[int]] = {}
@@ -2421,7 +2518,7 @@ class ContinuousScheduler:
             for slot, st in self._active.items():
                 self.pool.alloc.truncate(slot, st.pos)
         else:
-            self.pool.caches = _pool_rollback(
+            self.pool.caches = self._fn_pool_rollback(
                 self.pool.caches, jnp.asarray(delta)  # tpa: disable=TPA005 — the linter's linear scan pairs this dense-branch donation with the paged verify call above; the branches are mutually exclusive and every donating call rebinds immediately
             )
         if rollback_span is not None:
@@ -2534,7 +2631,7 @@ class ContinuousScheduler:
                             evicted = self.prefix_cache.insert(
                                 st.ids, aligned,
                                 lambda start: jax.device_get(
-                                    _slot_read_blocks(
+                                    self._fn_slot_read_blocks(
                                         self.pool.caches, jnp.int32(slot),
                                         jnp.int32(start), B,
                                     )

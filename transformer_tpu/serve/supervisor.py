@@ -106,9 +106,19 @@ class Supervisor:
         boot_timeout_s: float = 60.0,
         warm_prefixes: int = 8,
         warm_timeout_s: float = 10.0,
+        expected_mesh: "str | None" = None,
         clock=time.monotonic,
     ):
         self._spawn = spawn
+        # Sharded-replica shape contract (serve/sharded.py): when the
+        # fleet is launched with --mesh, every replica — initial spawn,
+        # respawn, scale-up — must come up at this canonical 'data=N'
+        # shape. A replacement announcing a DIFFERENT mesh (stale argv,
+        # hand-edited recipe, platform that lost devices) is refused
+        # loudly at on_ready: killed, counted against the restart budget,
+        # and surfaced as a route.mesh_mismatch event — never admitted to
+        # serve traffic at the wrong shape.
+        self.expected_mesh = expected_mesh
         # Live-weights fix (serve/upgrade.py): a respawn must bootstrap at
         # the fleet's CURRENT target version (Router.weight_target), not
         # the original argv checkpoint — otherwise a heal after a rollout
@@ -315,6 +325,28 @@ class Supervisor:
         survivor (or no caches), admit cold immediately."""
         slot = self._slot(link.index)
         if slot.phase != "booting":
+            return
+        if (
+            self.expected_mesh is not None
+            and getattr(link, "mesh", None) != self.expected_mesh
+        ):
+            # Wrong-shape refusal: the replacement bootstrapped at a mesh
+            # the fleet does not run. Serving it would break the byte-
+            # parity contract the shape encodes (and a later export/inject
+            # would cross layouts), so refuse BEFORE warm-up or traffic:
+            # loud event, kill, one budgeted failure, back off and retry
+            # through the deterministic argv.
+            now = self._clock()
+            self._router.emit_event(
+                "route.mesh_mismatch", replica=slot.name,
+                expected=self.expected_mesh,
+                got=getattr(link, "mesh", None),
+            )
+            link.kill()
+            self._count_failure(slot, now)
+            if slot.phase != "gave_up":
+                slot.phase = "waiting"
+                slot.next_try = now + self._backoff_s(slot.attempts)
             return
         survivor = self._pick_survivor(link.index)
         if survivor is None:
